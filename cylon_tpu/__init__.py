@@ -1,0 +1,34 @@
+"""cylon_tpu — a TPU-native distributed dataframe engine.
+
+A from-scratch rebuild of the capabilities of Cylon (reference mounted at
+/root/reference): Arrow-style columnar tables resident in TPU HBM,
+relational kernels (join, union, intersect, subtract, groupby, sort) as
+vectorized JAX/Pallas programs, and the distributed shuffle mapped onto XLA
+collectives (`all_to_all`, `psum`) over ICI/DCN under `shard_map` SPMD —
+no MPI, no per-rank processes, one controller driving a device mesh.
+"""
+
+from .config import (CommConfig, CommType, CSVReadOptions, CSVWriteOptions,
+                     LocalConfig, MPIConfig, MultiHostConfig, ParquetOptions,
+                     TPUConfig)
+from .context import CylonContext
+from .data.column import Column
+from .data.row import Row
+from .data.table import Table, concat_tables, join, set_op
+from .dtypes import DataType, Layout, Type
+from .io.csv import read_csv, write_csv
+from .io.parquet import read_parquet, write_parquet
+from .ops.groupby import AggregationOp
+from .ops.join import JoinAlgorithm, JoinConfig, JoinType
+from .status import Code, CylonError, Status
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AggregationOp", "Code", "Column", "CommConfig", "CommType",
+    "CSVReadOptions", "CSVWriteOptions", "CylonContext", "CylonError",
+    "DataType", "JoinAlgorithm", "JoinConfig", "JoinType", "Layout",
+    "LocalConfig", "MPIConfig", "MultiHostConfig", "ParquetOptions", "Row",
+    "Status", "TPUConfig", "Table", "Type", "concat_tables", "join",
+    "read_csv", "read_parquet", "set_op", "write_csv", "write_parquet",
+]

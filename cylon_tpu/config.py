@@ -1,0 +1,271 @@
+"""Configuration objects for cylon_tpu.
+
+Comm configs mirror the reference's CommConfig/MPIConfig/CommType
+(reference: cpp/src/cylon/net/comm_config.hpp:22-36, comm_type.hpp:20-22,
+python/pycylon/net/) with TPU-native backends: instead of MPI ranks there is
+one controller process per host driving a `jax.sharding.Mesh` of TPU chips;
+"world size" is the number of mesh devices and the comm fabric is ICI/DCN
+via XLA collectives.
+
+IO option builders mirror io/csv_read_config.hpp, csv_write_config.hpp and
+io/parquet_config.hpp (fluent style), backed by pyarrow reader options.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional, Sequence
+
+from .dtypes import DataType
+
+
+class CommType(enum.IntEnum):
+    """Reference: net/comm_type.hpp. TPU backends replace MPI/TCP/UCX."""
+
+    LOCAL = 0   # single device, no collectives (reference: local ctx)
+    TPU = 1     # single-process mesh over ICI (replaces MPI single-node)
+    MULTIHOST = 2  # jax.distributed multi-host mesh over ICI+DCN (replaces MPI cluster)
+
+
+class CommConfig:
+    """Abstract comm config (reference: net/comm_config.hpp:22-36)."""
+
+    def comm_type(self) -> CommType:
+        raise NotImplementedError
+
+
+class LocalConfig(CommConfig):
+    """Single-device, non-distributed context."""
+
+    def comm_type(self) -> CommType:
+        return CommType.LOCAL
+
+
+class TPUConfig(CommConfig):
+    """Single-controller mesh over the process's visible devices.
+
+    Replaces the reference's MPIConfig (python/pycylon/net/mpi_config.pyx):
+    where MPI launches W processes, we build one 1-D device mesh of W chips
+    and run every distributed op as an SPMD shard_map program over it.
+
+    Args:
+      devices: explicit device list (default: all ``jax.devices()``).
+      world_size: use only the first ``world_size`` devices.
+    """
+
+    def __init__(self, devices=None, world_size: Optional[int] = None):
+        self.devices = devices
+        self.world_size = world_size
+
+    def comm_type(self) -> CommType:
+        return CommType.TPU
+
+
+class MultiHostConfig(CommConfig):
+    """Multi-host mesh: calls ``jax.distributed.initialize`` then builds the
+    global mesh spanning all hosts (ICI within a slice, DCN across slices).
+
+    Replaces the reference's mpirun-launched multi-node MPI world
+    (reference: mpi_communicator.cpp:41-70).
+    """
+
+    def __init__(self, coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None):
+        self.coordinator_address = coordinator_address
+        self.num_processes = num_processes
+        self.process_id = process_id
+
+    def comm_type(self) -> CommType:
+        return CommType.MULTIHOST
+
+
+# Alias so reference-style code (`MPIConfig()`) keeps working with the
+# TPU backend underneath (pycylon parity: pycylon.net.MPIConfig).
+MPIConfig = TPUConfig
+
+
+class CSVReadOptions:
+    """Fluent CSV read options (reference: io/csv_read_config.hpp:27-147).
+
+    Both the reference's C++ PascalCase and pycylon's snake_case spellings
+    are provided (python/pycylon/io/csv_read_config.pyx).
+    """
+
+    def __init__(self):
+        self._use_threads = True
+        self._concurrent_file_reads = True
+        self._delimiter = ","
+        self._ignore_empty_lines = False
+        self._autogenerate_column_names = False
+        self._column_names: Optional[List[str]] = None
+        self._block_size = 1 << 20
+        self._quoting = False
+        self._quote_char = '"'
+        self._double_quote = True
+        self._escaping = False
+        self._escape_char = "\\"
+        self._newlines_in_values = False
+        self._skip_rows = 0
+        self._column_types: Optional[Dict[str, DataType]] = None
+        self._null_values: Optional[List[str]] = None
+        self._true_values: Optional[List[str]] = None
+        self._false_values: Optional[List[str]] = None
+        self._strings_can_be_null = False
+        self._include_columns: Optional[List[str]] = None
+        self._include_missing_columns = False
+        self._slice = False
+
+    # -- cylon-specific --
+    def ConcurrentFileReads(self, v: bool) -> "CSVReadOptions":
+        self._concurrent_file_reads = v
+        return self
+
+    def IsConcurrentFileReads(self) -> bool:
+        return self._concurrent_file_reads
+
+    # -- arrow-backed options --
+    def UseThreads(self, v: bool) -> "CSVReadOptions":
+        self._use_threads = v
+        return self
+
+    def WithDelimiter(self, d: str) -> "CSVReadOptions":
+        self._delimiter = d
+        return self
+
+    def IgnoreEmptyLines(self) -> "CSVReadOptions":
+        self._ignore_empty_lines = True
+        return self
+
+    def AutoGenerateColumnNames(self) -> "CSVReadOptions":
+        self._autogenerate_column_names = True
+        return self
+
+    def ColumnNames(self, names: Sequence[str]) -> "CSVReadOptions":
+        self._column_names = list(names)
+        return self
+
+    def BlockSize(self, n: int) -> "CSVReadOptions":
+        self._block_size = n
+        return self
+
+    def UseQuoting(self) -> "CSVReadOptions":
+        self._quoting = True
+        return self
+
+    def WithQuoteChar(self, c: str) -> "CSVReadOptions":
+        self._quote_char = c
+        return self
+
+    def DoubleQuote(self) -> "CSVReadOptions":
+        self._double_quote = True
+        return self
+
+    def UseEscaping(self) -> "CSVReadOptions":
+        self._escaping = True
+        return self
+
+    def EscapingCharacter(self, c: str) -> "CSVReadOptions":
+        self._escape_char = c
+        return self
+
+    def HasNewLinesInValues(self) -> "CSVReadOptions":
+        self._newlines_in_values = True
+        return self
+
+    def SkipRows(self, n: int) -> "CSVReadOptions":
+        self._skip_rows = n
+        return self
+
+    def WithColumnTypes(self, types: Dict[str, DataType]) -> "CSVReadOptions":
+        self._column_types = dict(types)
+        return self
+
+    def NullValues(self, vals: Sequence[str]) -> "CSVReadOptions":
+        self._null_values = list(vals)
+        return self
+
+    def TrueValues(self, vals: Sequence[str]) -> "CSVReadOptions":
+        self._true_values = list(vals)
+        return self
+
+    def FalseValues(self, vals: Sequence[str]) -> "CSVReadOptions":
+        self._false_values = list(vals)
+        return self
+
+    def StringsCanBeNull(self) -> "CSVReadOptions":
+        self._strings_can_be_null = True
+        return self
+
+    def IncludeColumns(self, cols: Sequence[str]) -> "CSVReadOptions":
+        self._include_columns = list(cols)
+        return self
+
+    def IncludeMissingColumns(self) -> "CSVReadOptions":
+        self._include_missing_columns = True
+        return self
+
+    # -- pycylon snake_case aliases (csv_read_config.pyx:32-45) --
+    def use_threads(self, v: bool) -> "CSVReadOptions":
+        return self.UseThreads(v)
+
+    def block_size(self, n: int) -> "CSVReadOptions":
+        return self.BlockSize(n)
+
+    def with_delimiter(self, d: str) -> "CSVReadOptions":
+        return self.WithDelimiter(d)
+
+    def ignore_emptylines(self) -> "CSVReadOptions":
+        return self.IgnoreEmptyLines()
+
+    def skip_rows(self, n: int) -> "CSVReadOptions":
+        return self.SkipRows(n)
+
+
+class CSVWriteOptions:
+    """Reference: io/csv_write_config.hpp:20-52."""
+
+    def __init__(self):
+        self._delimiter = ","
+        self._column_names: Optional[List[str]] = None
+
+    def WithDelimiter(self, d: str) -> "CSVWriteOptions":
+        self._delimiter = d
+        return self
+
+    def ColumnNames(self, names: Sequence[str]) -> "CSVWriteOptions":
+        self._column_names = list(names)
+        return self
+
+    def GetDelimiter(self) -> str:
+        return self._delimiter
+
+    def GetColumnNames(self) -> Optional[List[str]]:
+        return self._column_names
+
+    def IsOverrideColumnNames(self) -> bool:
+        return self._column_names is not None
+
+    # pycylon snake_case
+    def with_delimiter(self, d: str) -> "CSVWriteOptions":
+        return self.WithDelimiter(d)
+
+
+class ParquetOptions:
+    """Reference: io/parquet_config.hpp (chunk size + writer properties)."""
+
+    def __init__(self):
+        self._chunk_size = 64 * 1024
+        self._compression: Optional[str] = None
+        self._concurrent_file_reads = True
+
+    def ChunkSize(self, n: int) -> "ParquetOptions":
+        self._chunk_size = n
+        return self
+
+    def WithCompression(self, codec: str) -> "ParquetOptions":
+        self._compression = codec
+        return self
+
+    def ConcurrentFileReads(self, v: bool) -> "ParquetOptions":
+        self._concurrent_file_reads = v
+        return self

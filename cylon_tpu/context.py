@@ -1,0 +1,148 @@
+"""CylonContext — the entry point object.
+
+Mirrors the reference's CylonContext (reference: cpp/src/cylon/ctx/
+cylon_context.hpp:29-146 — Init/InitDistributed, GetRank/GetWorldSize,
+GetNextSequence, Barrier, string config map) re-designed for the TPU
+execution model:
+
+* an MPI *world of W processes* becomes a *1-D device mesh of W chips*
+  driven by one controller process per host (SPMD via shard_map/pjit);
+* ``rank``/``world_size`` become mesh coordinates; on multi-host meshes the
+  controller's ``jax.process_index()`` plays the reference's node-rank role
+  for file IO placement;
+* ``Barrier`` becomes a device synchronization (block_until_ready on a tiny
+  psum) — program order inside XLA replaces MPI tag ordering;
+* ``GetNextSequence`` survives as the op-sequence counter used to key
+  shuffle "edges" for tracing/profiling (the reference used it as the MPI
+  tag: cylon_context.cpp:94-99).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .config import CommConfig, CommType, LocalConfig, TPUConfig, MultiHostConfig
+from .status import Code, CylonError
+
+_AXIS = "p"  # the canonical 1-D mesh axis name for row partitioning
+
+
+class CylonContext:
+    """Holds the device mesh, distributed flag and op sequence counter."""
+
+    def __init__(self, config: Optional[CommConfig] = None, distributed: bool = False):
+        # pycylon parity: CylonContext(config=MPIConfig(), distributed=True)
+        # (python/pycylon/ctx/context.pyx:29-75)
+        self._config_map: Dict[str, str] = {}
+        self._sequence = 0
+        self._lock = threading.Lock()
+        self._finalized = False
+
+        if config is None and not distributed:
+            config = LocalConfig()
+        elif config is None:
+            config = TPUConfig()
+
+        self.comm_config = config
+        ct = config.comm_type()
+        self.distributed = distributed and ct != CommType.LOCAL
+
+        if ct == CommType.MULTIHOST:
+            cfg: MultiHostConfig = config  # type: ignore[assignment]
+            if jax.process_count() == 1 and cfg.num_processes not in (None, 1):
+                jax.distributed.initialize(
+                    coordinator_address=cfg.coordinator_address,
+                    num_processes=cfg.num_processes,
+                    process_id=cfg.process_id,
+                )
+            devices = jax.devices()
+        elif ct == CommType.TPU:
+            cfg2: TPUConfig = config  # type: ignore[assignment]
+            devices = list(cfg2.devices) if cfg2.devices is not None else jax.devices()
+            if cfg2.world_size is not None:
+                if cfg2.world_size > len(devices):
+                    raise CylonError(
+                        Code.Invalid,
+                        f"world_size {cfg2.world_size} > available devices {len(devices)}")
+                devices = devices[: cfg2.world_size]
+        else:
+            devices = [jax.devices()[0]]
+
+        if not self.distributed:
+            devices = devices[:1]
+
+        self.devices: List = devices
+        self.mesh = jax.sharding.Mesh(np.array(devices), (_AXIS,))
+
+    # -- reference API (cylon_context.hpp) --
+
+    @staticmethod
+    def Init() -> "CylonContext":
+        """Local (single-device) context. Reference: CylonContext::Init."""
+        return CylonContext(LocalConfig(), distributed=False)
+
+    @staticmethod
+    def InitDistributed(config: Optional[CommConfig] = None) -> "CylonContext":
+        """Distributed context over the device mesh.
+
+        Reference: CylonContext::InitDistributed (cylon_context.cpp:32-43).
+        """
+        return CylonContext(config or TPUConfig(), distributed=True)
+
+    def get_world_size(self) -> int:
+        """Number of mesh devices (reference: GetWorldSize = MPI world size)."""
+        return len(self.devices)
+
+    def get_rank(self) -> int:
+        """Controller process index. In the reference every rank is a process;
+        here one controller drives all local chips, so `rank` is only
+        meaningful for multi-host file placement."""
+        return jax.process_index()
+
+    def get_neighbours(self, include_self: bool = False) -> List[int]:
+        """Reference: GetNeighbours (cylon_context.cpp:77-86)."""
+        w = self.get_world_size()
+        return [i for i in range(w) if include_self]
+
+    def get_next_sequence(self) -> int:
+        """Monotonic op id — the reference used it as the MPI comm tag
+        (cylon_context.cpp:94-99); we key profiler annotations with it."""
+        with self._lock:
+            self._sequence += 1
+            return self._sequence
+
+    def barrier(self) -> None:
+        """Synchronize all devices (reference: MPI_Barrier)."""
+        if self._finalized:
+            return
+        x = jax.device_put(np.zeros((), np.int32), self.devices[0])
+        jax.block_until_ready(x + 1)
+
+    def finalize(self) -> None:
+        self._finalized = True
+
+    def is_distributed(self) -> bool:
+        return self.distributed
+
+    # string config map (cylon_context.hpp:31)
+    def add_config(self, key: str, value: str) -> None:
+        self._config_map[key] = value
+
+    def get_config(self, key: str, default: str = "") -> str:
+        return self._config_map.get(key, default)
+
+    # -- TPU-native additions --
+
+    @property
+    def axis(self) -> str:
+        return _AXIS
+
+    # PascalCase aliases for reference-style call sites
+    GetRank = get_rank
+    GetWorldSize = get_world_size
+    GetNextSequence = get_next_sequence
+    Barrier = barrier
+    Finalize = finalize

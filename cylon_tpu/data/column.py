@@ -1,0 +1,254 @@
+"""Column — a typed, device-resident column with optional validity mask.
+
+Mirrors the reference's Column (reference: cpp/src/cylon/column.hpp:31-113 —
+id + DataType + arrow::ChunkedArray) with a TPU-native representation:
+
+* fixed-width data is ONE dense jax array in HBM (the reference's
+  CombineChunks "one chunk per column" invariant, table.cpp:374-379, is
+  structural here);
+* nullability is a separate boolean mask array (Arrow validity-bitmap
+  analog) — absent mask means "all valid";
+* STRING/BINARY columns are dictionary-encoded: a *sorted* host-side
+  vocabulary (numpy object array) + int32 codes in HBM. Because the vocab is
+  sorted, code order == lexicographic order, so device-side sort/join/
+  group-by on strings are integer ops on the MXU-friendly codes. Cross-table
+  ops unify vocabularies host-side and re-map codes with one device gather
+  (`unify_dictionaries`).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from ..dtypes import DataType, Type
+from ..status import Code, CylonError
+
+
+class Column:
+    def __init__(self, data, dtype: DataType, validity=None, dictionary=None,
+                 name: str = ""):
+        self.data = data              # jnp array [n] (codes for STRING)
+        self.dtype = dtype
+        self.validity = validity      # jnp bool [n] (True=valid) or None
+        self.dictionary = dictionary  # np.ndarray (sorted) for STRING/BINARY
+        self.name = name
+
+    # -- construction --
+
+    @staticmethod
+    def from_numpy(arr: np.ndarray, name: str = "",
+                   validity: Optional[np.ndarray] = None) -> "Column":
+        arr = np.asarray(arr)
+        if arr.dtype.kind in ("U", "S", "O"):
+            return Column._encode_strings(arr, name, validity)
+        if arr.dtype.kind == "M":  # datetime64
+            unit = np.datetime_data(arr.dtype)[0]
+            dt = dtypes.Timestamp(_np_unit(unit))
+            data = jnp.asarray(arr.astype("int64"))
+            return Column(data, dt, _dev_mask(validity), None, name)
+        if arr.dtype.kind == "m":
+            unit = np.datetime_data(arr.dtype)[0]
+            dt = dtypes.Duration(_np_unit(unit))
+            return Column(jnp.asarray(arr.astype("int64")), dt,
+                          _dev_mask(validity), None, name)
+        if arr.dtype.kind == "f" and validity is None and np.isnan(arr).any():
+            # pandas-style: NaN means null for float columns coming from host
+            validity = ~np.isnan(arr)
+        dt = dtypes.from_np_dtype(arr.dtype)
+        return Column(jnp.asarray(arr), dt, _dev_mask(validity), None, name)
+
+    @staticmethod
+    def _encode_strings(arr: np.ndarray, name: str,
+                        validity: Optional[np.ndarray]) -> "Column":
+        obj = arr.astype(object)
+        if validity is None:
+            validity = np.array([v is not None and v == v for v in obj], dtype=bool)
+        filler = ""
+        safe = np.array([v if ok else filler for v, ok in zip(obj, validity)],
+                        dtype=object)
+        vocab, codes = np.unique(safe.astype(str), return_inverse=True)
+        col = Column(jnp.asarray(codes.astype(np.int32)), dtypes.String(),
+                     _dev_mask(validity if not validity.all() else None),
+                     vocab, name)
+        return col
+
+    @staticmethod
+    def from_pyarrow(pa_arr, name: str = "") -> "Column":
+        """Build from a pyarrow Array/ChunkedArray (combines chunks)."""
+        import pyarrow as pa
+
+        if isinstance(pa_arr, pa.ChunkedArray):
+            pa_arr = pa_arr.combine_chunks()
+        if isinstance(pa_arr, pa.ChunkedArray):  # 0-chunk edge
+            pa_arr = pa.concat_arrays(pa_arr.chunks) if pa_arr.num_chunks else \
+                pa.array([], type=pa_arr.type)
+        t = pa_arr.type
+        nulls = pa_arr.null_count > 0
+        if pa.types.is_string(t) or pa.types.is_large_string(t) or \
+                pa.types.is_binary(t) or pa.types.is_large_binary(t):
+            np_obj = pa_arr.to_numpy(zero_copy_only=False)
+            validity = np.array([v is not None for v in np_obj]) if nulls else None
+            return Column._encode_strings(np.asarray(np_obj, dtype=object), name, validity)
+        if pa.types.is_dictionary(t):
+            return Column.from_pyarrow(pa_arr.dictionary_decode(), name)
+        np_arr = pa_arr.to_numpy(zero_copy_only=False)
+        validity = None
+        if nulls:
+            validity = np.asarray(pa_arr.is_valid())
+            if np_arr.dtype.kind == "f":
+                np_arr = np.nan_to_num(np_arr)  # keep device data finite where null
+            elif np_arr.dtype == object:
+                fill = 0
+                np_arr = np.array([v if ok else fill
+                                   for v, ok in zip(np_arr, validity)])
+        return Column.from_numpy(np_arr, name, validity)
+
+    @staticmethod
+    def Make(ctx, name, dtype, values) -> "Column":
+        """Reference parity: Column::Make / VectorColumn::Make (column.hpp:84-113)."""
+        del ctx
+        c = Column.from_numpy(np.asarray(values), name)
+        if c.dtype.type != dtype.type and not c.dtype.is_var_width():
+            c = c.astype(dtype)
+        return c
+
+    # -- properties --
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def is_string(self) -> bool:
+        return self.dictionary is not None
+
+    def null_count(self) -> int:
+        if self.validity is None:
+            return 0
+        return int((~self.validity).sum())
+
+    def valid_mask(self) -> jnp.ndarray:
+        if self.validity is None:
+            return jnp.ones(self.data.shape[0], dtype=bool)
+        return self.validity
+
+    # -- transforms --
+
+    def astype(self, dtype: DataType) -> "Column":
+        if self.is_string:
+            raise CylonError(Code.TypeError, "cannot cast string column")
+        return Column(self.data.astype(dtype.np_dtype), dtype, self.validity,
+                      None, self.name)
+
+    def take(self, indices, fill_invalid: bool = True) -> "Column":
+        """Gather rows; negative indices produce NULL rows (the reference's
+        −1→null gather, util/copy_arrray.cpp:16-287)."""
+        idx = jnp.asarray(indices)
+        if self.data.shape[0] == 0:
+            data = jnp.zeros(idx.shape, self.data.dtype)
+            return Column(data, self.dtype, jnp.zeros(idx.shape, bool),
+                          self.dictionary, self.name)
+        neg = idx < 0
+        safe = jnp.where(neg, 0, idx)
+        data = jnp.take(self.data, safe, axis=0)
+        valid = jnp.take(self.valid_mask(), safe, axis=0) & ~neg
+        validity = None
+        if fill_invalid or self.validity is not None:
+            validity = valid
+        if validity is not None and bool(validity.all()):
+            validity = None
+        return Column(data, self.dtype, validity, self.dictionary, self.name)
+
+    def slice(self, start: int, stop: int) -> "Column":
+        v = None if self.validity is None else self.validity[start:stop]
+        return Column(self.data[start:stop], self.dtype, v, self.dictionary,
+                      self.name)
+
+    def rename(self, name: str) -> "Column":
+        return Column(self.data, self.dtype, self.validity, self.dictionary, name)
+
+    # -- export --
+
+    def to_numpy(self) -> np.ndarray:
+        data = np.asarray(jax.device_get(self.data))
+        if self.is_string:
+            out = self.dictionary[data].astype(object)
+            if self.validity is not None:
+                mask = np.asarray(jax.device_get(self.validity))
+                out[~mask] = None
+            return out
+        if self.validity is not None:
+            mask = np.asarray(jax.device_get(self.validity))
+            if data.dtype.kind == "f":
+                out = data.astype(data.dtype, copy=True)
+                out[~mask] = np.nan
+                return out
+            out = data.astype(object)
+            out[~mask] = None
+            return out
+        if self.dtype.is_temporal():
+            unit = {None: "us"}.get(self.dtype.unit, None)
+            unit = _unit_str(self.dtype.unit)
+            if self.dtype.type == Type.TIMESTAMP:
+                return data.astype(f"datetime64[{unit}]")
+            if self.dtype.type == Type.DURATION:
+                return data.astype(f"timedelta64[{unit}]")
+        return data
+
+    def to_pyarrow(self):
+        import pyarrow as pa
+
+        data = np.asarray(jax.device_get(self.data))
+        mask = None
+        if self.validity is not None:
+            mask = ~np.asarray(jax.device_get(self.validity))
+        if self.is_string:
+            vals = self.dictionary[data]
+            return pa.array(vals, type=pa.string(),
+                            mask=mask if mask is not None else None)
+        return pa.array(data, mask=mask)
+
+
+def unify_dictionaries(a: Column, b: Column) -> Tuple[Column, Column]:
+    """Re-encode two string columns onto one shared *sorted* vocabulary so
+    their codes are directly comparable on device. Host cost is O(|vocab|);
+    device cost is one gather per column."""
+    if not (a.is_string and b.is_string):
+        raise CylonError(Code.TypeError, "unify_dictionaries needs string columns")
+    if a.dictionary.shape == b.dictionary.shape and \
+            (a.dictionary == b.dictionary).all():
+        return a, b
+    union = np.union1d(a.dictionary, b.dictionary)
+    map_a = jnp.asarray(np.searchsorted(union, a.dictionary).astype(np.int32))
+    map_b = jnp.asarray(np.searchsorted(union, b.dictionary).astype(np.int32))
+    na = Column(jnp.take(map_a, a.data), a.dtype, a.validity, union, a.name)
+    nb = Column(jnp.take(map_b, b.data), b.dtype, b.validity, union, b.name)
+    return na, nb
+
+
+def _dev_mask(validity: Optional[np.ndarray]):
+    if validity is None:
+        return None
+    v = np.asarray(validity, dtype=bool)
+    if v.all():
+        return None
+    return jnp.asarray(v)
+
+
+def _np_unit(unit: str):
+    from ..dtypes import TimeUnit
+
+    return {"s": TimeUnit.SECOND, "ms": TimeUnit.MILLI,
+            "us": TimeUnit.MICRO, "ns": TimeUnit.NANO}[unit]
+
+
+def _unit_str(unit) -> str:
+    from ..dtypes import TimeUnit
+
+    if unit is None:
+        return "us"
+    return {TimeUnit.SECOND: "s", TimeUnit.MILLI: "ms",
+            TimeUnit.MICRO: "us", TimeUnit.NANO: "ns"}[unit]

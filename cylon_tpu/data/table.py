@@ -1,0 +1,747 @@
+"""Table — the user-facing columnar table.
+
+Mirrors the reference's `cylon::Table` + free-function operator API
+(reference: cpp/src/cylon/table.hpp:43-387) and the pycylon surface
+(python/pycylon/data/table.pyx:65-798), re-designed for the TPU execution
+model:
+
+* a Table is a GLOBAL view: a list of Columns whose arrays live in device
+  HBM. On a distributed context the arrays are row-sharded over the 1-D
+  mesh (jax.sharding.NamedSharding) — the reference's "one partition per
+  MPI rank" becomes "one shard per chip", but the user holds ONE object,
+  exactly like a global jax.Array.
+* sharded tables carry a row-validity mask (`row_mask`): shards are padded
+  to equal length (XLA static shapes), padding rows are masked out. This is
+  the moral equivalent of Cylon's ragged per-rank partitions.
+* every local op accepts the mask ("emit") so padded tables flow through
+  kernels without host round-trips; compaction happens only at export.
+
+Distributed ops (distributed_join & co) live in cylon_tpu/parallel and are
+re-exported as methods here, following the reference's dual local/
+distributed API (table.hpp:262-336).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from ..config import CSVWriteOptions
+from ..context import CylonContext
+from ..status import Code, CylonError
+from .column import Column, unify_dictionaries
+from ..ops import aggregates as _aggregates
+from ..ops import groupby as _groupby
+from ..ops import join as _join
+from ..ops import order as _order
+from ..ops import setops as _setops
+
+
+class Table:
+    def __init__(self, columns: List[Column], ctx: Optional[CylonContext] = None,
+                 row_mask=None):
+        self._columns = columns
+        self._ctx = ctx or CylonContext.Init()
+        self.row_mask = row_mask  # bool [n] or None (all rows live)
+        if columns:
+            n = len(columns[0])
+            for c in columns:
+                if len(c) != n:
+                    raise CylonError(Code.Invalid, "ragged columns")
+
+    # ------------------------------------------------------------------
+    # properties (pycylon parity: table.pyx column_names/column_count/...)
+    # ------------------------------------------------------------------
+
+    @property
+    def context(self) -> CylonContext:
+        return self._ctx
+
+    @property
+    def column_names(self) -> List[str]:
+        return [c.name for c in self._columns]
+
+    @property
+    def column_count(self) -> int:
+        return len(self._columns)
+
+    @property
+    def row_count(self) -> int:
+        if not self._columns:
+            return 0
+        if self.row_mask is None:
+            return len(self._columns[0])
+        return int(self.row_mask.sum())
+
+    def columns(self) -> List[Column]:
+        return self._columns
+
+    def get_column(self, i: int) -> Column:
+        return self._columns[i]
+
+    def rows(self) -> int:
+        """Reference: Table::Rows (table.hpp:134)."""
+        return self.row_count
+
+    def __len__(self) -> int:
+        return self.row_count
+
+    @property
+    def capacity(self) -> int:
+        """Physical (padded) row slots."""
+        return len(self._columns[0]) if self._columns else 0
+
+    def emit_mask(self) -> jnp.ndarray:
+        if self.row_mask is None:
+            return jnp.ones(self.capacity, dtype=bool)
+        return self.row_mask
+
+    # ------------------------------------------------------------------
+    # constructors (pycylon: from_arrow/from_numpy/from_list/from_pydict/
+    # from_pandas, table.pyx:556-624)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def from_arrow(ctx: CylonContext, pa_table) -> "Table":
+        cols = [Column.from_pyarrow(pa_table.column(i), pa_table.column_names[i])
+                for i in range(pa_table.num_columns)]
+        return Table(cols, ctx)
+
+    @staticmethod
+    def from_pandas(ctx: CylonContext, df) -> "Table":
+        cols = []
+        for name in df.columns:
+            s = df[name]
+            validity = None
+            if s.isna().any():
+                validity = (~s.isna()).to_numpy()
+            arr = s.to_numpy()
+            cols.append(Column.from_numpy(arr, str(name), validity))
+        return Table(cols, ctx)
+
+    @staticmethod
+    def from_numpy(ctx: CylonContext, col_names: Sequence[str],
+                   arrays: Sequence[np.ndarray]) -> "Table":
+        if len(col_names) != len(arrays):
+            raise CylonError(Code.Invalid, "names/arrays length mismatch")
+        cols = [Column.from_numpy(np.asarray(a), n)
+                for n, a in zip(col_names, arrays)]
+        return Table(cols, ctx)
+
+    @staticmethod
+    def from_pydict(ctx: CylonContext, data: Dict[str, Sequence]) -> "Table":
+        return Table.from_numpy(ctx, list(data.keys()),
+                                [np.asarray(v) for v in data.values()])
+
+    @staticmethod
+    def from_list(ctx: CylonContext, col_names: Sequence[str],
+                  data: Sequence[Sequence]) -> "Table":
+        return Table.from_numpy(ctx, col_names, [np.asarray(v) for v in data])
+
+    # ------------------------------------------------------------------
+    # exporters (table.pyx:626-693)
+    # ------------------------------------------------------------------
+
+    def _compact_indices(self) -> Optional[np.ndarray]:
+        if self.row_mask is None:
+            return None
+        return np.flatnonzero(np.asarray(jax.device_get(self.row_mask)))
+
+    def compact(self) -> "Table":
+        """Drop masked rows; returns a dense table."""
+        idx = self._compact_indices()
+        if idx is None:
+            return self
+        cols = [c.take(jnp.asarray(idx)) for c in self._columns]
+        return Table(cols, self._ctx)
+
+    def to_pydict(self) -> Dict[str, np.ndarray]:
+        t = self.compact()
+        return {c.name: c.to_numpy() for c in t._columns}
+
+    def to_numpy(self, order: str = "F") -> np.ndarray:
+        t = self.compact()
+        arrs = [c.to_numpy() for c in t._columns]
+        return np.array(arrs).T.copy() if order == "F" else \
+            np.ascontiguousarray(np.array(arrs).T)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        t = self.compact()
+        return pd.DataFrame({c.name: c.to_numpy() for c in t._columns})
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        t = self.compact()
+        return pa.table({c.name: c.to_pyarrow() for c in t._columns})
+
+    def to_csv(self, path: str, options: Optional[CSVWriteOptions] = None) -> None:
+        from ..io.csv import write_csv
+
+        write_csv(self, path, options)
+
+    # reference: Table::WriteCSV (table.hpp:92)
+    write_csv = to_csv
+
+    def to_parquet(self, path: str) -> None:
+        from ..io.parquet import write_parquet
+
+        write_parquet(self, path)
+
+    def show(self, row1: int = 0, row2: int = -1, col1: int = 0,
+             col2: int = -1) -> None:
+        """Print (pycylon table.pyx show/show_by_range)."""
+        df = self.to_pandas()
+        if row2 == -1:
+            row2 = len(df)
+        if col2 == -1:
+            col2 = df.shape[1]
+        print(df.iloc[row1:row2, col1:col2].to_string(index=False))
+
+    print = show  # reference: Table::Print
+
+    def clear(self) -> None:
+        self._columns = []
+        self.row_mask = None
+
+    def retain_memory(self, retain: bool = True) -> None:
+        """Reference: Table::retainMemory (table.hpp:178) — a free-after-use
+        hint. JAX arrays are refcounted; accepted for API parity, no-op."""
+        del retain
+
+    def finalize(self) -> None:
+        self.clear()
+
+    # ------------------------------------------------------------------
+    # row selection / projection
+    # ------------------------------------------------------------------
+
+    def take(self, indices) -> "Table":
+        """Gather rows by index; −1 produces null rows."""
+        idx = jnp.asarray(indices)
+        cols = [c.take(idx) for c in self._columns]
+        return Table(cols, self._ctx)
+
+    def project(self, columns: Sequence[Union[int, str]]) -> "Table":
+        """Zero-copy column subset (reference: Project, table.cpp:1066-1085)."""
+        idxs = [self._col_index(c) for c in columns]
+        return Table([self._columns[i] for i in idxs], self._ctx, self.row_mask)
+
+    def select(self, predicate) -> "Table":
+        """Row-lambda filter (reference: Select, table.cpp:698-727 — a host
+        row loop in the reference too; prefer mask-based filtering for speed)."""
+        t = self.compact()
+        data = [c.to_numpy() for c in t._columns]
+        n = len(data[0]) if data else 0
+        mask = np.zeros(n, dtype=bool)
+        from .row import Row
+
+        for i in range(n):
+            mask[i] = bool(predicate(Row(t, i, _cache=data)))
+        return t.filter_mask(jnp.asarray(mask))
+
+    def filter_mask(self, mask) -> "Table":
+        """Filter by a boolean mask array/column (vectorized path)."""
+        mask = jnp.asarray(mask)
+        keep = mask & self.emit_mask()
+        total = int(keep.sum())
+        (idx,) = jnp.nonzero(keep, size=_pow2(total), fill_value=-1)
+        return self.take(idx[:total])
+
+    def slice(self, start: int, stop: int) -> "Table":
+        t = self.compact()
+        return Table([c.slice(start, stop) for c in t._columns], self._ctx)
+
+    def _col_index(self, c: Union[int, str]) -> int:
+        if isinstance(c, (int, np.integer)):
+            return int(c)
+        try:
+            return self.column_names.index(c)
+        except ValueError:
+            raise CylonError(Code.KeyError, f"no column named {c!r}")
+
+    # ------------------------------------------------------------------
+    # sort / merge
+    # ------------------------------------------------------------------
+
+    def sort(self, order_by: Union[int, str, Sequence],
+             ascending: Union[bool, Sequence[bool]] = True) -> "Table":
+        """Local sort (reference: Sort, table.cpp / util/arrow_utils.cpp:144-184
+        — argsort the key column then gather every column)."""
+        t = self.compact()
+        cols_idx = [t._col_index(c) for c in
+                    (order_by if isinstance(order_by, (list, tuple)) else [order_by])]
+        asc = ascending if isinstance(ascending, (list, tuple)) \
+            else [ascending] * len(cols_idx)
+        keys = _order.sort_keys([t._columns[i] for i in cols_idx], asc)
+        perm = _order.lexsort_indices(keys)
+        return t.take(perm)
+
+    def merge(self, other_or_list) -> "Table":
+        """Concatenate tables (reference: Merge, table.hpp:250)."""
+        others = other_or_list if isinstance(other_or_list, (list, tuple)) \
+            else [other_or_list]
+        tables = [self.compact()] + [o.compact() for o in others]
+        return concat_tables(tables, self._ctx)
+
+    # ------------------------------------------------------------------
+    # joins
+    # ------------------------------------------------------------------
+
+    def join(self, table: "Table", join_type: str = "inner",
+             algorithm: str = "sort", **kwargs) -> "Table":
+        """Local join; self is the LEFT table (pycylon table.pyx:373-390)."""
+        cfg = self._make_join_config(table, join_type, algorithm, kwargs)
+        return join(self, table, cfg)
+
+    def distributed_join(self, table: "Table", join_type: str = "inner",
+                         algorithm: str = "sort", **kwargs) -> "Table":
+        from ..parallel import dist_ops
+
+        cfg = self._make_join_config(table, join_type, algorithm, kwargs)
+        return dist_ops.distributed_join(self, table, cfg)
+
+    def _make_join_config(self, table: "Table", join_type, algorithm, kwargs
+                          ) -> _join.JoinConfig:
+        lidx, ridx = _resolve_join_columns(self, table, kwargs)
+        jt = _JOIN_TYPES.get(join_type if not isinstance(join_type, _join.JoinType)
+                             else join_type.name.lower())
+        if isinstance(join_type, _join.JoinType):
+            jt = join_type
+        if jt is None:
+            raise CylonError(Code.Invalid, f"Unsupported join type {join_type}")
+        alg = _JOIN_ALGOS.get(algorithm, _join.JoinAlgorithm.SORT) \
+            if isinstance(algorithm, str) else algorithm
+        return _join.JoinConfig(jt, lidx, ridx, alg)
+
+    # ------------------------------------------------------------------
+    # set ops (pycylon table.pyx:411-457)
+    # ------------------------------------------------------------------
+
+    def union(self, table: "Table") -> "Table":
+        return set_op(self, table, _setops.SetOp.UNION)
+
+    def subtract(self, table: "Table") -> "Table":
+        return set_op(self, table, _setops.SetOp.SUBTRACT)
+
+    def intersect(self, table: "Table") -> "Table":
+        return set_op(self, table, _setops.SetOp.INTERSECT)
+
+    def distributed_union(self, table: "Table") -> "Table":
+        from ..parallel import dist_ops
+
+        return dist_ops.distributed_set_op(self, table, _setops.SetOp.UNION)
+
+    def distributed_subtract(self, table: "Table") -> "Table":
+        from ..parallel import dist_ops
+
+        return dist_ops.distributed_set_op(self, table, _setops.SetOp.SUBTRACT)
+
+    def distributed_intersect(self, table: "Table") -> "Table":
+        from ..parallel import dist_ops
+
+        return dist_ops.distributed_set_op(self, table, _setops.SetOp.INTERSECT)
+
+    # ------------------------------------------------------------------
+    # aggregates (pycylon table.pyx:485-522)
+    # ------------------------------------------------------------------
+
+    def _agg(self, column, op: str):
+        i = self._col_index(column) if not isinstance(column, Column) else None
+        col = self._columns[i] if i is not None else column
+        if self.row_mask is not None:
+            valid = col.valid_mask() & self.emit_mask()
+            col = Column(col.data, col.dtype, valid, col.dictionary, col.name)
+        value = _aggregates.agg_scalar(col, op)
+        if self._ctx.is_distributed():
+            pass  # arrays are global; reduction already spans all shards
+        return Table.from_pydict(self._ctx, {col.name: [value]})
+
+    def sum(self, column) -> "Table":
+        return self._agg(column, "sum")
+
+    def count(self, column) -> "Table":
+        return self._agg(column, "count")
+
+    def min(self, column) -> "Table":
+        return self._agg(column, "min")
+
+    def max(self, column) -> "Table":
+        return self._agg(column, "max")
+
+    def mean(self, column) -> "Table":
+        return self._agg(column, "mean")
+
+    # ------------------------------------------------------------------
+    # groupby (pycylon table.pyx:524-554)
+    # ------------------------------------------------------------------
+
+    def groupby(self, index_col: int, aggregate_cols: Sequence,
+                aggregate_ops: Sequence) -> "Table":
+        ops = [_as_agg_op(o) for o in aggregate_ops]
+        if self._ctx.is_distributed() and self._ctx.get_world_size() > 1:
+            from ..parallel import dist_ops
+
+            return dist_ops.distributed_groupby(self, index_col,
+                                                list(aggregate_cols), ops)
+        return groupby_local(self, index_col, list(aggregate_cols), ops)
+
+    # ------------------------------------------------------------------
+    # pandas-style sugar (pycylon table.pyx:749-798)
+    # ------------------------------------------------------------------
+
+    def __getitem__(self, key):
+        if isinstance(key, Table):  # boolean mask table
+            if key.column_count != 1:
+                # full-table mask: AND across columns? pycylon uses filter result
+                raise CylonError(Code.Invalid, "mask table must have one column")
+            mask = key._columns[0].data.astype(bool)
+            return self.filter_mask(mask)
+        if isinstance(key, slice):
+            return self.slice(key.start or 0,
+                              key.stop if key.stop is not None else self.row_count)
+        if isinstance(key, int):
+            return self.slice(key, key + 1)
+        if isinstance(key, str):
+            return self.project([key])
+        if isinstance(key, (list, tuple)):
+            return self.project(list(key))
+        raise CylonError(Code.Invalid, f"unsupported key {key!r}")
+
+    def _compare(self, other, op) -> "Table":
+        t = self.compact()
+        out_cols = []
+        for c in t._columns:
+            if c.is_string:
+                if isinstance(other, str):
+                    code = np.searchsorted(c.dictionary, other)
+                    hit = (code < len(c.dictionary)) and \
+                        c.dictionary[code] == other
+                    if op == "eq":
+                        res = (c.data == int(code)) if hit else \
+                            jnp.zeros(len(c), bool)
+                    elif op == "ne":
+                        res = (c.data != int(code)) if hit else \
+                            jnp.ones(len(c), bool)
+                    else:
+                        raise CylonError(Code.TypeError,
+                                         "ordering vs str uses dictionary order")
+                else:
+                    raise CylonError(Code.TypeError, "string col vs non-str")
+            else:
+                o = other
+                res = _CMP[op](c.data, o)
+            res = res & c.valid_mask()
+            out_cols.append(Column(res, dtypes.Bool(), None, None, c.name))
+        return Table(out_cols, self._ctx)
+
+    def __eq__(self, other):  # type: ignore[override]
+        if isinstance(other, Table):
+            return NotImplemented
+        return self._compare(other, "eq")
+
+    def __ne__(self, other):  # type: ignore[override]
+        if isinstance(other, Table):
+            return NotImplemented
+        return self._compare(other, "ne")
+
+    def __lt__(self, other):
+        return self._compare(other, "lt")
+
+    def __gt__(self, other):
+        return self._compare(other, "gt")
+
+    def __le__(self, other):
+        return self._compare(other, "le")
+
+    def __ge__(self, other):
+        return self._compare(other, "ge")
+
+    def __hash__(self):
+        return id(self)
+
+    def _bool_binop(self, other: "Table", fn) -> "Table":
+        cols = [Column(fn(a.data.astype(bool), b.data.astype(bool)),
+                       dtypes.Bool(), None, None, a.name)
+                for a, b in zip(self._columns, other._columns)]
+        return Table(cols, self._ctx)
+
+    def __and__(self, other: "Table") -> "Table":
+        return self._bool_binop(other, jnp.logical_and)
+
+    def __or__(self, other: "Table") -> "Table":
+        return self._bool_binop(other, jnp.logical_or)
+
+    def __invert__(self) -> "Table":
+        cols = [Column(~c.data.astype(bool), dtypes.Bool(), None, None, c.name)
+                for c in self._columns]
+        return Table(cols, self._ctx)
+
+    def __repr__(self) -> str:
+        return f"Table({self.row_count}x{self.column_count} " \
+               f"cols={self.column_names})"
+
+
+_CMP = {
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b,
+    "gt": lambda a, b: a > b,
+    "le": lambda a, b: a <= b,
+    "ge": lambda a, b: a >= b,
+}
+
+_JOIN_TYPES = {
+    "inner": _join.JoinType.INNER,
+    "left": _join.JoinType.LEFT,
+    "right": _join.JoinType.RIGHT,
+    "outer": _join.JoinType.FULL_OUTER,
+    "full_outer": _join.JoinType.FULL_OUTER,
+}
+
+_JOIN_ALGOS = {"sort": _join.JoinAlgorithm.SORT,
+               "hash": _join.JoinAlgorithm.HASH}
+
+
+def _as_agg_op(o) -> _groupby.AggregationOp:
+    if isinstance(o, _groupby.AggregationOp):
+        return o
+    if isinstance(o, str):
+        return _groupby.AggregationOp[o.upper()]
+    return _groupby.AggregationOp(int(o))
+
+
+def _pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (int(n) - 1).bit_length()
+
+
+def _resolve_join_columns(left: Table, right: Table, kwargs
+                          ) -> Tuple[List[int], List[int]]:
+    """pycylon's on=/left_on=/right_on= resolution (table.pyx:228-266)."""
+    on = kwargs.get("on")
+    left_on = kwargs.get("left_on")
+    right_on = kwargs.get("right_on")
+    if on is not None:
+        names = on if isinstance(on, (list, tuple)) else [on]
+        li = [left._col_index(c) for c in names]
+        ri = [right._col_index(c) for c in names]
+        return li, ri
+    if left_on is not None and right_on is not None:
+        lo = left_on if isinstance(left_on, (list, tuple)) else [left_on]
+        ro = right_on if isinstance(right_on, (list, tuple)) else [right_on]
+        return ([left._col_index(c) for c in lo],
+                [right._col_index(c) for c in ro])
+    raise CylonError(Code.Invalid,
+                     "kwargs 'on' or 'left_on' and 'right_on' must be provided")
+
+
+# ---------------------------------------------------------------------------
+# Key preparation shared by join/set ops/shuffle
+# ---------------------------------------------------------------------------
+
+def align_key_columns(left: Table, right: Table, lidx: List[int],
+                      ridx: List[int]) -> Tuple[List[Column], List[Column]]:
+    """Promote dtypes / unify string vocabularies so both sides' key columns
+    are directly comparable on device."""
+    lcols, rcols = [], []
+    for li, ri in zip(lidx, ridx):
+        a, b = left._columns[li], right._columns[ri]
+        if a.is_string != b.is_string:
+            raise CylonError(Code.TypeError,
+                             f"join key type mismatch: {a.name} vs {b.name}")
+        if a.is_string:
+            a, b = unify_dictionaries(a, b)
+        elif a.data.dtype != b.data.dtype:
+            common = jnp.promote_types(a.data.dtype, b.data.dtype)
+            a = Column(a.data.astype(common), a.dtype, a.validity, None, a.name)
+            b = Column(b.data.astype(common), b.dtype, b.validity, None, b.name)
+        lcols.append(a)
+        rcols.append(b)
+    return lcols, rcols
+
+
+def join_gids(left: Table, right: Table, lidx: List[int], ridx: List[int]
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared dense key ids for a join; null keys get non-matching
+    sentinels (SQL semantics: NULL joins nothing)."""
+    lcols, rcols = align_key_columns(left, right, lidx, ridx)
+    keys_l = _order.sort_keys(lcols)
+    keys_r = _order.sort_keys(rcols)
+    gl, gr = _order.dense_ranks_two(keys_l, keys_r)
+    lvalid = _all_valid(lcols)
+    rvalid = _all_valid(rcols)
+    gl = jnp.where(lvalid, gl, _join.LEFT_NULL_GID)
+    gr = jnp.where(rvalid, gr, _join.RIGHT_NULL_GID)
+    return gl, gr
+
+
+def _all_valid(cols: Sequence[Column]) -> jnp.ndarray:
+    v = cols[0].valid_mask()
+    for c in cols[1:]:
+        v = v & c.valid_mask()
+    return v
+
+
+def row_gids(left: Table, right: Table) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared dense FULL-ROW ids for set ops; nulls compare equal (validity
+    participates in the key, matching set-distinct semantics)."""
+    if left.column_count != right.column_count:
+        raise CylonError(Code.Invalid, "set ops need equal schemas")
+    lidx = list(range(left.column_count))
+    lcols, rcols = align_key_columns(left, right, lidx, lidx)
+    keys_l, keys_r = [], []
+    for a, b in zip(lcols, rcols):
+        keys_l.append(_order.sort_keys([a])[0])
+        keys_r.append(_order.sort_keys([b])[0])
+        if a.validity is not None or b.validity is not None:
+            keys_l.append(a.valid_mask().astype(jnp.uint8))
+            keys_r.append(b.valid_mask().astype(jnp.uint8))
+    return _order.dense_ranks_two(keys_l, keys_r)
+
+
+# ---------------------------------------------------------------------------
+# Free-function operator API (reference: table.hpp:228-387)
+# ---------------------------------------------------------------------------
+
+def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
+    """Local join (reference: cylon::Join, table.cpp:640-654)."""
+    gl, gr = join_gids(left, right, config.left_column_idx,
+                       config.right_column_idx)
+    lidx, ridx = _join.join_indices(gl, gr, left.emit_mask(),
+                                    right.emit_mask(), config.type)
+    return _materialize_join(left, right, lidx, ridx)
+
+
+def _materialize_join(left: Table, right: Table, lidx, ridx) -> Table:
+    """Gather + rename with the reference's lt-/rt- schema
+    (join_utils.cpp:47-56: fields are concatenated then prefixed by
+    originating side with their global index)."""
+    li = jnp.asarray(lidx)
+    ri = jnp.asarray(ridx)
+    cols = []
+    nl = left.column_count
+    for i, c in enumerate(left._columns):
+        cols.append(c.take(li).rename(f"lt-{i}"))
+    for j, c in enumerate(right._columns):
+        cols.append(c.take(ri).rename(f"rt-{nl + j}"))
+    return Table(cols, left._ctx)
+
+
+def set_op(left: Table, right: Table, op) -> Table:
+    """Local union/subtract/intersect (reference: table.cpp:729-942)."""
+    gl, gr = row_gids(left, right)
+    rows = _setops.setop_rows(gl, gr, left.emit_mask(), right.emit_mask(), op)
+    nl = left.capacity
+    out_cols = []
+    for ci in range(left.column_count):
+        a, b = left._columns[ci], right._columns[ci]
+        if a.is_string:
+            a, b = unify_dictionaries(a, b)
+        elif a.data.dtype != b.data.dtype:
+            common = jnp.promote_types(a.data.dtype, b.data.dtype)
+            a = a.astype(dtypes.from_np_dtype(common))
+            b = b.astype(dtypes.from_np_dtype(common))
+        data = jnp.concatenate([a.data, b.data])
+        validity = None
+        if a.validity is not None or b.validity is not None:
+            validity = jnp.concatenate([a.valid_mask(), b.valid_mask()])
+        merged = Column(data, a.dtype, validity, a.dictionary, a.name)
+        out_cols.append(merged.take(jnp.asarray(rows)))
+    return Table(out_cols, left._ctx)
+
+
+def concat_tables(tables: Sequence[Table], ctx: CylonContext) -> Table:
+    """Reference: Merge (table.cpp:388-427) — schema-aligned concat."""
+    first = tables[0]
+    out_cols = []
+    for ci in range(first.column_count):
+        cs = [t._columns[ci] for t in tables]
+        if cs[0].is_string:
+            # unify all vocabularies pairwise-left-fold
+            base = cs[0]
+            unified = [base]
+            for c in cs[1:]:
+                base, c2 = unify_dictionaries(base, c)
+                unified = [Column(u.data if u.dictionary is base.dictionary
+                                  else jnp.take(jnp.asarray(
+                                      np.searchsorted(base.dictionary,
+                                                      u.dictionary).astype(np.int32)),
+                                      u.data),
+                                  u.dtype, u.validity, base.dictionary, u.name)
+                           for u in unified]
+                unified.append(c2)
+            cs = unified
+        data = jnp.concatenate([c.data for c in cs])
+        has_null = any(c.validity is not None for c in cs)
+        validity = jnp.concatenate([c.valid_mask() for c in cs]) if has_null \
+            else None
+        out_cols.append(Column(data, cs[0].dtype, validity, cs[0].dictionary,
+                               cs[0].name))
+    mask = None
+    if any(t.row_mask is not None for t in tables):
+        mask = jnp.concatenate([t.emit_mask() for t in tables])
+    return Table(out_cols, ctx, mask)
+
+
+def groupby_local(table: Table, index_col, aggregate_cols: List,
+                  aggregate_ops: List, second_phase: bool = False) -> Table:
+    """Local hash-groupby equivalent (reference: LocalHashGroupBy,
+    groupby_hash.hpp:321-359). ``second_phase`` merges partials with the
+    corrected ops (COUNT→SUM)."""
+    idx_cols = index_col if isinstance(index_col, (list, tuple)) else [index_col]
+    idx_cols = [table._col_index(c) for c in idx_cols]
+    val_cols = [table._col_index(c) for c in aggregate_cols]
+    ops = [(_groupby.second_phase_op(o) if second_phase else o)
+           for o in aggregate_ops]
+
+    key_columns = [table._columns[i] for i in idx_cols]
+    keys = _order.sort_keys(key_columns)
+    for c in key_columns:
+        if c.validity is not None:
+            keys.append(c.valid_mask().astype(jnp.uint8))
+    emit = table.emit_mask()
+    # rank only emitted rows: give masked rows the max key so they land in
+    # one trailing group, then drop it via the overflow-slot trick
+    gid, _ = _order.dense_ranks(keys)
+    num_groups = int(jnp.where(emit, gid, -1).max()) + 1
+    if num_groups <= 0:
+        num_groups = 1
+    cap = _pow2(num_groups)
+
+    values = tuple(table._columns[i].data for i in val_cols)
+    valids = tuple(table._columns[i].valid_mask() for i in val_cols)
+    rep, group_valid, results = _groupby.segment_aggregate(
+        gid, values, valids, emit, cap, tuple(ops))
+
+    # materialize: keep groups that exist (gid space may have holes when
+    # masked rows held their own ids — group_valid filters them)
+    gv = np.asarray(jax.device_get(group_valid))
+    live = np.flatnonzero(gv)
+    rep_h = jnp.asarray(np.asarray(jax.device_get(rep))[live])
+
+    out_cols = [table._columns[i].take(rep_h) for i in idx_cols]
+    for (arr, avalid), vi, op in zip(results, val_cols, aggregate_ops):
+        src = table._columns[vi]
+        col = Column(arr[jnp.asarray(live)], _agg_dtype(src, op),
+                     avalid[jnp.asarray(live)],
+                     src.dictionary if op in (_groupby.AggregationOp.MIN,
+                                              _groupby.AggregationOp.MAX)
+                     and src.is_string else None,
+                     src.name)
+        if col.validity is not None and bool(col.validity.all()):
+            col.validity = None
+        out_cols.append(col)
+    return Table(out_cols, table._ctx)
+
+
+def _agg_dtype(src: Column, op) -> dtypes.DataType:
+    if op == _groupby.AggregationOp.COUNT:
+        return dtypes.Int64()
+    if op == _groupby.AggregationOp.MEAN:
+        return dtypes.Double()
+    return src.dtype

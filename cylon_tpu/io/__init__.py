@@ -1,0 +1,4 @@
+from .csv import read_csv, write_csv
+from .parquet import read_parquet, write_parquet
+
+__all__ = ["read_csv", "write_csv", "read_parquet", "write_parquet"]

@@ -1,0 +1,33 @@
+"""Parquet IO (reference: io/arrow_io.cpp:64-113 + parquet.cpp, flag-gated
+by BUILD_CYLON_PARQUET; always available here)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from ..config import ParquetOptions
+from ..context import CylonContext
+from ..data.table import Table, concat_tables
+from ..status import Code, CylonError
+
+
+def read_parquet(ctx: CylonContext, path: Union[str, Sequence[str]],
+                 options: Optional[ParquetOptions] = None) -> Table:
+    import pyarrow.parquet as pq
+
+    if isinstance(path, (list, tuple)):
+        return concat_tables([read_parquet(ctx, p, options) for p in path], ctx)
+    try:
+        pa_table = pq.read_table(path)
+    except FileNotFoundError as e:
+        raise CylonError(Code.IOError, str(e))
+    return Table.from_arrow(ctx, pa_table)
+
+
+def write_parquet(table: Table, path: str,
+                  options: Optional[ParquetOptions] = None) -> None:
+    import pyarrow.parquet as pq
+
+    options = options or ParquetOptions()
+    pq.write_table(table.to_arrow(), path,
+                   row_group_size=options._chunk_size,
+                   compression=options._compression or "snappy")

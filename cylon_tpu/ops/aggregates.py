@@ -1,0 +1,66 @@
+"""Scalar column aggregates (table-level Sum/Count/Min/Max).
+
+Reference: cpp/src/cylon/compute/aggregates.cpp:113-339 — local Arrow
+compute reduction followed by `mpi::AllReduce` on the scalar
+(mpi_operations.cpp:61-78). Here the local reduction is a jnp reduction and
+the cross-device combine is free: when the column is sharded over the mesh,
+XLA lowers the same reduction to per-shard partials + an ICI all-reduce.
+Null handling matches Arrow: nulls are skipped; Count counts non-null rows.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data.column import Column
+from ..status import Code, CylonError
+from .groupby import _max_of, _min_of
+
+
+@jax.jit
+def _sum(data, valid):
+    return jnp.where(valid, data, 0).sum()
+
+
+@jax.jit
+def _count(valid):
+    return valid.sum()
+
+
+@jax.jit
+def _min(data, valid):
+    return jnp.where(valid, data, _max_of(data.dtype)).min()
+
+
+@jax.jit
+def _max(data, valid):
+    return jnp.where(valid, data, _min_of(data.dtype)).max()
+
+
+def agg_scalar(col: Column, op: str):
+    """Compute one scalar aggregate of a column; returns a Python scalar."""
+    if col.is_string and op in ("sum", "mean"):
+        raise CylonError(Code.TypeError, f"{op} unsupported for string column")
+    valid = col.valid_mask()
+    if op == "count":
+        return int(_count(valid))
+    if col.is_string:
+        # min/max by dictionary order -> decode the code
+        code = (_min if op == "min" else _max)(col.data, valid)
+        return str(col.dictionary[int(code)])
+    if op == "sum":
+        return _py(_sum(col.data, valid))
+    if op == "min":
+        return _py(_min(col.data, valid))
+    if op == "max":
+        return _py(_max(col.data, valid))
+    if op == "mean":
+        s = _sum(col.data.astype(jnp.float64), valid)
+        c = _count(valid)
+        return float(s) / max(int(c), 1)
+    raise CylonError(Code.Invalid, f"unknown aggregate {op}")
+
+
+def _py(x):
+    v = x.item()
+    return v

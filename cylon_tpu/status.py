@@ -1,0 +1,70 @@
+"""Status/error model for cylon_tpu.
+
+Mirrors the reference's return-value error propagation (reference:
+cpp/src/cylon/status.hpp:21-63, cpp/src/cylon/code.cpp) but exposes it
+Python-idiomatically: every public op raises :class:`CylonError` carrying a
+:class:`Code`, and a :class:`Status` object is available for call sites that
+prefer the reference's non-throwing style.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class Code(enum.IntEnum):
+    """Error codes (reference: cpp/src/cylon/code.cpp)."""
+
+    OK = 0
+    OutOfMemory = 1
+    KeyError = 2
+    TypeError = 3
+    Invalid = 4
+    IOError = 5
+    CapacityError = 6
+    IndexError = 7
+    UnknownError = 8
+    NotImplemented = 9
+    SerializationError = 10
+    RError = 11
+    CodeGenError = 12
+    ExpressionValidationError = 13
+    ExecutionError = 14
+    AlreadyExists = 15
+
+
+@dataclass(frozen=True)
+class Status:
+    """Reference: cpp/src/cylon/status.hpp:21-63 (`Status::OK/is_ok/get_code/get_msg`)."""
+
+    code: Code = Code.OK
+    msg: str = ""
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status(Code.OK, "")
+
+    def is_ok(self) -> bool:
+        return self.code == Code.OK
+
+    def get_code(self) -> Code:
+        return self.code
+
+    def get_msg(self) -> str:
+        return self.msg
+
+    def raise_if_error(self) -> None:
+        if not self.is_ok():
+            raise CylonError(self.code, self.msg)
+
+
+class CylonError(Exception):
+    """Exception carrying a :class:`Code`; the Python-native face of Status."""
+
+    def __init__(self, code: Code, msg: str):
+        super().__init__(f"[{code.name}] {msg}")
+        self.code = code
+        self.msg = msg
+
+    def status(self) -> Status:
+        return Status(self.code, self.msg)

@@ -1,0 +1,83 @@
+"""Group-by + scalar aggregate tests.
+
+Parity model: cpp/test/groupby_test.cpp, aggregate_test.cpp,
+python/test/test_table_compute (world=1).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+
+
+def df(seed=0, n=80, keys=9):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({"k": rng.integers(0, keys, n).astype(np.int64),
+                         "a": rng.random(n),
+                         "b": rng.integers(-100, 100, n).astype(np.int64)})
+
+
+@pytest.mark.parametrize("op,pd_op", [("sum", "sum"), ("min", "min"),
+                                      ("max", "max"), ("count", "count"),
+                                      ("mean", "mean")])
+def test_groupby_single_agg(local_ctx, op, pd_op):
+    d = df()
+    t = ct.Table.from_pandas(local_ctx, d)
+    got = t.groupby(0, [1], [op]).to_pandas().sort_values("k").reset_index(drop=True)
+    exp = d.groupby("k")["a"].agg(pd_op).reset_index()
+    np.testing.assert_array_equal(got["k"].values, exp["k"].values)
+    np.testing.assert_allclose(got["a"].values.astype(float),
+                               exp["a"].values.astype(float), rtol=1e-9)
+
+
+def test_groupby_multi_agg(local_ctx):
+    d = df(3)
+    t = ct.Table.from_pandas(local_ctx, d)
+    got = t.groupby(0, [1, 2], ["sum", "max"]).to_pandas() \
+        .sort_values("k").reset_index(drop=True)
+    exp = d.groupby("k").agg(a=("a", "sum"), b=("b", "max")).reset_index()
+    np.testing.assert_allclose(got["a"].values, exp["a"].values)
+    np.testing.assert_array_equal(got["b"].values, exp["b"].values)
+
+
+def test_groupby_string_keys(local_ctx):
+    d = pd.DataFrame({"k": ["x", "y", "x", "z", "y", "x"],
+                      "v": [1, 2, 3, 4, 5, 6]})
+    t = ct.Table.from_pandas(local_ctx, d)
+    got = t.groupby(0, [1], ["sum"]).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    exp = d.groupby("k")["v"].sum().reset_index()
+    assert list(got["k"]) == list(exp["k"])
+    np.testing.assert_array_equal(got["v"].values, exp["v"].values)
+
+
+def test_groupby_enum_ops(local_ctx):
+    d = df(4)
+    t = ct.Table.from_pandas(local_ctx, d)
+    got = t.groupby(0, [2], [ct.AggregationOp.MIN])
+    exp = d.groupby("k")["b"].min()
+    assert got.row_count == len(exp)
+
+
+def test_groupby_null_values_skipped(local_ctx):
+    d = pd.DataFrame({"k": [1, 1, 2, 2], "v": [1.0, np.nan, np.nan, np.nan]})
+    t = ct.Table.from_pandas(local_ctx, d)
+    got = t.groupby(0, [1], ["count"]).to_pandas().sort_values("k")
+    np.testing.assert_array_equal(got["v"].values, [1, 0])
+
+
+@pytest.mark.parametrize("op", ["sum", "count", "min", "max", "mean"])
+def test_scalar_aggregates(local_ctx, op):
+    d = df(5)
+    t = ct.Table.from_pandas(local_ctx, d)
+    got = getattr(t, op)("a").to_pandas().iloc[0, 0]
+    exp = getattr(d["a"], op)()
+    np.testing.assert_allclose(float(got), float(exp), rtol=1e-9)
+
+
+def test_aggregate_with_nulls(local_ctx):
+    d = pd.DataFrame({"a": [1.0, np.nan, 3.0]})
+    t = ct.Table.from_pandas(local_ctx, d)
+    assert float(t.sum("a").to_pandas().iloc[0, 0]) == 4.0
+    assert int(t.count("a").to_pandas().iloc[0, 0]) == 2
+    assert float(t.min("a").to_pandas().iloc[0, 0]) == 1.0

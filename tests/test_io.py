@@ -1,0 +1,79 @@
+"""CSV/Parquet IO tests (reference: python/test/test_csv_read_options.py,
+cpp create_table_test)."""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+
+
+def test_read_csv_basic(local_ctx, tmp_path):
+    p = tmp_path / "t.csv"
+    df = pd.DataFrame({"a": [1, 2, 3], "b": [0.1, 0.2, 0.3]})
+    df.to_csv(p, index=False)
+    t = ct.read_csv(local_ctx, str(p))
+    assert t.row_count == 3
+    assert t.column_names == ["a", "b"]
+
+
+def test_read_csv_options(local_ctx, tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("x;y\n1;hello\n2;world\n")
+    opts = ct.CSVReadOptions().WithDelimiter(";").UseThreads(False) \
+        .BlockSize(1 << 16)
+    t = ct.read_csv(local_ctx, str(p), opts)
+    assert t.column_names == ["x", "y"]
+    assert list(t.to_pydict()["y"]) == ["hello", "world"]
+
+
+def test_read_csv_multi_file(local_ctx, tmp_path):
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"f{i}.csv"
+        pd.DataFrame({"a": [i, i + 10]}).to_csv(p, index=False)
+        paths.append(str(p))
+    t = ct.read_csv(local_ctx, paths)
+    assert t.row_count == 6
+
+
+def test_write_csv_roundtrip(local_ctx, tmp_path):
+    df = pd.DataFrame({"a": [1, 2], "s": ["x", "y"]})
+    t = ct.Table.from_pandas(local_ctx, df)
+    out = tmp_path / "o.csv"
+    t.to_csv(str(out))
+    back = pd.read_csv(out)
+    pd.testing.assert_frame_equal(back, df)
+
+
+def test_write_csv_options(local_ctx, tmp_path):
+    df = pd.DataFrame({"a": [1], "b": [2]})
+    t = ct.Table.from_pandas(local_ctx, df)
+    out = tmp_path / "o.csv"
+    t.to_csv(str(out), ct.CSVWriteOptions().WithDelimiter("|").ColumnNames(["c", "d"]))
+    text = out.read_text()
+    assert text.splitlines()[0] == "c|d"
+
+
+def test_parquet_roundtrip(local_ctx, tmp_path):
+    df = pd.DataFrame({"a": np.arange(10), "s": [f"v{i}" for i in range(10)]})
+    t = ct.Table.from_pandas(local_ctx, df)
+    p = tmp_path / "t.parquet"
+    t.to_parquet(str(p))
+    back = ct.read_parquet(local_ctx, str(p))
+    pd.testing.assert_frame_equal(back.to_pandas(), df, check_dtype=False)
+
+
+def test_read_reference_parquet(local_ctx):
+    path = "/root/reference/data/input/parquet1_0.parquet"
+    if not os.path.exists(path):
+        pytest.skip("no reference parquet")
+    t = ct.read_parquet(local_ctx, path)
+    assert t.row_count > 0
+
+
+def test_missing_file_raises(local_ctx):
+    with pytest.raises(ct.CylonError) as e:
+        ct.read_csv(local_ctx, "/nonexistent/file.csv")
+    assert e.value.code == ct.Code.IOError

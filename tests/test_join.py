@@ -1,0 +1,114 @@
+"""Local join tests — value-exact vs pandas merge, all join types.
+
+Parity model: python/test/test_rl.py + cpp/test/join_test.cpp (world=1).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from conftest import assert_rows_equal
+
+
+def dfs(seed=0, nl=60, nr=45, keys=15):
+    rng = np.random.default_rng(seed)
+    l = pd.DataFrame({"k": rng.integers(0, keys, nl).astype(np.int64),
+                      "v": rng.random(nl)})
+    r = pd.DataFrame({"k": rng.integers(0, keys, nr).astype(np.int64),
+                      "w": rng.random(nr)})
+    return l, r
+
+
+@pytest.mark.parametrize("jt,how", [("inner", "inner"), ("left", "left"),
+                                    ("right", "right"), ("outer", "outer")])
+@pytest.mark.parametrize("algo", ["sort", "hash"])
+def test_join_types_values(local_ctx, jt, how, algo):
+    l, r = dfs()
+    tl = ct.Table.from_pandas(local_ctx, l)
+    tr = ct.Table.from_pandas(local_ctx, r)
+    out = tl.join(tr, jt, algo, on=["k"]).to_pandas()
+    assert list(out.columns) == ["lt-0", "lt-1", "rt-2", "rt-3"]
+    exp = l.merge(r, on="k", how=how)
+    # expand expected to 4 columns (k both sides)
+    exp4 = pd.DataFrame({
+        0: exp["k"], 1: exp["v"], 2: exp["k"], 3: exp["w"]})
+    if how in ("left", "outer"):
+        exp4.loc[exp["w"].isna(), 2] = np.nan
+    if how in ("right", "outer"):
+        exp4.loc[exp["v"].isna(), 0] = np.nan
+    assert_rows_equal(out, exp4, msg=f"join {jt}")
+
+
+def test_join_on_indices(local_ctx):
+    l, r = dfs(3)
+    tl = ct.Table.from_pandas(local_ctx, l)
+    tr = ct.Table.from_pandas(local_ctx, r)
+    a = tl.join(tr, "inner", "sort", on=[0]).to_pandas()
+    b = tl.join(tr, "inner", "sort", left_on=["k"], right_on=["k"]).to_pandas()
+    assert len(a) == len(b)
+
+
+def test_join_string_keys(local_ctx):
+    l = pd.DataFrame({"k": ["a", "b", "c", "a", "d"], "v": [1, 2, 3, 4, 5]})
+    r = pd.DataFrame({"k": ["b", "a", "e", "a"], "w": [10, 20, 30, 40]})
+    tl = ct.Table.from_pandas(local_ctx, l)
+    tr = ct.Table.from_pandas(local_ctx, r)
+    out = tl.join(tr, "inner", "sort", on=["k"]).to_pandas()
+    exp = l.merge(r, on="k", how="inner")
+    assert len(out) == len(exp)  # a:2x2=4 + b:1 = 5
+    got_keys = sorted(out["lt-0"])
+    assert got_keys == sorted(exp["k"])
+
+
+def test_join_multi_column_keys(local_ctx):
+    rng = np.random.default_rng(5)
+    l = pd.DataFrame({"k1": rng.integers(0, 5, 40),
+                      "k2": rng.choice(["x", "y", "z"], 40),
+                      "v": rng.random(40)})
+    r = pd.DataFrame({"k1": rng.integers(0, 5, 30),
+                      "k2": rng.choice(["x", "y", "z"], 30),
+                      "w": rng.random(30)})
+    tl = ct.Table.from_pandas(local_ctx, l)
+    tr = ct.Table.from_pandas(local_ctx, r)
+    out = tl.join(tr, "inner", "sort", on=["k1", "k2"])
+    exp = l.merge(r, on=["k1", "k2"], how="inner")
+    assert out.row_count == len(exp)
+
+
+def test_join_null_keys_dont_match(local_ctx):
+    l = pd.DataFrame({"k": [1.0, np.nan, 2.0], "v": [1, 2, 3]})
+    r = pd.DataFrame({"k": [1.0, np.nan, 3.0], "w": [10, 20, 30]})
+    tl = ct.Table.from_pandas(local_ctx, l)
+    tr = ct.Table.from_pandas(local_ctx, r)
+    inner = tl.join(tr, "inner", "sort", on=["k"])
+    assert inner.row_count == 1  # only k=1 matches; NaN != NaN
+    left = tl.join(tr, "left", "sort", on=["k"])
+    assert left.row_count == 3
+
+
+def test_join_empty_right(local_ctx):
+    l = pd.DataFrame({"k": [1, 2], "v": [1.0, 2.0]})
+    r = pd.DataFrame({"k": np.array([], dtype=np.int64),
+                      "w": np.array([], dtype=np.float64)})
+    tl = ct.Table.from_pandas(local_ctx, l)
+    tr = ct.Table.from_pandas(local_ctx, r)
+    assert tl.join(tr, "inner", "sort", on=["k"]).row_count == 0
+    assert tl.join(tr, "left", "sort", on=["k"]).row_count == 2
+    assert tl.join(tr, "outer", "sort", on=["k"]).row_count == 2
+
+
+def test_join_dtype_promotion(local_ctx):
+    l = pd.DataFrame({"k": np.array([1, 2, 3], dtype=np.int32), "v": [1, 2, 3]})
+    r = pd.DataFrame({"k": np.array([2, 3, 4], dtype=np.int64), "w": [5, 6, 7]})
+    tl = ct.Table.from_pandas(local_ctx, l)
+    tr = ct.Table.from_pandas(local_ctx, r)
+    assert tl.join(tr, "inner", "sort", on=["k"]).row_count == 2
+
+
+def test_join_config_factories():
+    cfg = ct.JoinConfig.InnerJoin(0, 1)
+    assert cfg.GetType() == ct.JoinType.INNER
+    assert cfg.GetLeftColumnIdx() == [0]
+    assert cfg.GetRightColumnIdx() == [1]
+    cfg2 = ct.JoinConfig.FullOuterJoin(0, 0, ct.JoinAlgorithm.HASH)
+    assert cfg2.GetAlgorithm() == ct.JoinAlgorithm.HASH

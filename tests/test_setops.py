@@ -1,0 +1,65 @@
+"""Set operation tests (union/subtract/intersect) — distinct semantics.
+
+Parity model: cpp/test/set_op_test.cpp (world=1 sections).
+"""
+import numpy as np
+import pandas as pd
+
+import cylon_tpu as ct
+
+
+def sets(seed=0):
+    rng = np.random.default_rng(seed)
+    l = pd.DataFrame({"x": rng.integers(0, 12, 35),
+                      "y": rng.choice(["p", "q", "r"], 35)})
+    r = pd.DataFrame({"x": rng.integers(0, 12, 28),
+                      "y": rng.choice(["p", "q", "r"], 28)})
+    return l, r
+
+
+def rowset(df):
+    return set(map(tuple, df.values))
+
+
+def test_union(local_ctx):
+    l, r = sets()
+    tl, tr = (ct.Table.from_pandas(local_ctx, d) for d in (l, r))
+    got = tl.union(tr).to_pandas()
+    exp = rowset(l) | rowset(r)
+    assert rowset(got) == exp
+    assert len(got) == len(exp)  # distinct
+
+
+def test_subtract(local_ctx):
+    l, r = sets(1)
+    tl, tr = (ct.Table.from_pandas(local_ctx, d) for d in (l, r))
+    got = tl.subtract(tr).to_pandas()
+    exp = rowset(l) - rowset(r)
+    assert rowset(got) == exp
+    assert len(got) == len(exp)
+
+
+def test_intersect(local_ctx):
+    l, r = sets(2)
+    tl, tr = (ct.Table.from_pandas(local_ctx, d) for d in (l, r))
+    got = tl.intersect(tr).to_pandas()
+    exp = rowset(l) & rowset(r)
+    assert rowset(got) == exp
+    assert len(got) == len(exp)
+
+
+def test_union_dedups_within_table(local_ctx):
+    l = pd.DataFrame({"x": [1, 1, 2]})
+    r = pd.DataFrame({"x": [3, 3]})
+    tl, tr = (ct.Table.from_pandas(local_ctx, d) for d in (l, r))
+    assert tl.union(tr).row_count == 3
+
+
+def test_setop_with_nulls(local_ctx):
+    # null rows compare equal to each other in set semantics
+    l = pd.DataFrame({"x": [1.0, np.nan, np.nan]})
+    r = pd.DataFrame({"x": [np.nan, 2.0]})
+    tl, tr = (ct.Table.from_pandas(local_ctx, d) for d in (l, r))
+    assert tl.union(tr).row_count == 3  # {1, null, 2}
+    assert tl.intersect(tr).row_count == 1  # {null}
+    assert tl.subtract(tr).row_count == 1  # {1}

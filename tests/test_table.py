@@ -1,0 +1,134 @@
+"""Table construction / conversion / property tests.
+
+Parity model: python/test/test_table_properties.py, test_pycylon_table.py
+(pandas/numpy/arrow round trips, masking, dunders).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+
+
+def make_df():
+    rng = np.random.default_rng(7)
+    return pd.DataFrame({
+        "i": rng.integers(-50, 50, 30).astype(np.int64),
+        "f": rng.random(30),
+        "s": rng.choice(["aa", "bb", "cc", "dd"], 30),
+        "b": rng.integers(0, 2, 30).astype(bool),
+    })
+
+
+def test_from_to_pandas(local_ctx):
+    df = make_df()
+    t = ct.Table.from_pandas(local_ctx, df)
+    assert t.row_count == 30
+    assert t.column_count == 4
+    assert t.column_names == ["i", "f", "s", "b"]
+    back = t.to_pandas()
+    pd.testing.assert_frame_equal(back, df, check_dtype=False)
+
+
+def test_from_pydict_roundtrip(local_ctx):
+    d = {"x": np.arange(5), "y": ["a", "b", "c", "d", "e"]}
+    t = ct.Table.from_pydict(local_ctx, d)
+    out = t.to_pydict()
+    np.testing.assert_array_equal(out["x"], d["x"])
+    assert list(out["y"]) == d["y"]
+
+
+def test_from_arrow_roundtrip(local_ctx):
+    import pyarrow as pa
+
+    pt = pa.table({"a": [1, 2, None, 4], "s": ["x", None, "z", "w"]})
+    t = ct.Table.from_arrow(local_ctx, pt)
+    assert t.row_count == 4
+    assert t.get_column(0).null_count() == 1
+    assert t.get_column(1).null_count() == 1
+    back = t.to_arrow()
+    assert back.column("a").null_count == 1
+    assert back.column("s").to_pylist() == ["x", None, "z", "w"]
+
+
+def test_to_numpy(local_ctx):
+    t = ct.Table.from_pydict(local_ctx, {"a": [1.0, 2.0], "b": [3.0, 4.0]})
+    arr = t.to_numpy()
+    assert arr.shape == (2, 2)
+    np.testing.assert_allclose(arr, [[1.0, 3.0], [2.0, 4.0]])
+
+
+def test_project_select_slice(local_ctx):
+    df = make_df()
+    t = ct.Table.from_pandas(local_ctx, df)
+    p = t.project(["s", "i"])
+    assert p.column_names == ["s", "i"]
+    p2 = t.project([0, 1])
+    assert p2.column_names == ["i", "f"]
+    sel = t.select(lambda row: row["i"] > 0)
+    assert sel.row_count == int((df["i"] > 0).sum())
+    sl = t.slice(5, 15)
+    assert sl.row_count == 10
+
+
+def test_getitem_and_dunders(local_ctx):
+    df = make_df()
+    t = ct.Table.from_pandas(local_ctx, df)
+    mask = t["i"] > 0
+    filtered = t[mask]
+    assert filtered.row_count == int((df["i"] > 0).sum())
+    both = t[(t["i"] > 0) & (t["f"] < 0.5)]
+    assert both.row_count == int(((df["i"] > 0) & (df["f"] < 0.5)).sum())
+    either = t[(t["i"] > 40) | (t["f"] > 0.9)]
+    assert either.row_count == int(((df["i"] > 40) | (df["f"] > 0.9)).sum())
+    eq = t["s"] == "aa"
+    assert t[eq].row_count == int((df["s"] == "aa").sum())
+
+
+def test_sort(local_ctx):
+    df = make_df()
+    t = ct.Table.from_pandas(local_ctx, df)
+    s = t.sort("i").to_pandas()
+    assert (np.diff(s["i"].values) >= 0).all()
+    s2 = t.sort(["s", "f"], [True, False]).to_pandas()
+    exp = df.sort_values(["s", "f"], ascending=[True, False])
+    np.testing.assert_array_equal(s2["s"].values, exp["s"].values)
+    np.testing.assert_allclose(s2["f"].values, exp["f"].values)
+
+
+def test_merge(local_ctx):
+    a = ct.Table.from_pydict(local_ctx, {"x": [1, 2], "s": ["p", "q"]})
+    b = ct.Table.from_pydict(local_ctx, {"x": [3, 4], "s": ["q", "r"]})
+    m = a.merge(b)
+    assert m.row_count == 4
+    assert list(m.to_pydict()["s"]) == ["p", "q", "q", "r"]
+
+
+def test_nulls_roundtrip(local_ctx):
+    df = pd.DataFrame({"a": [1.0, np.nan, 3.0], "s": ["x", None, "z"]})
+    t = ct.Table.from_pandas(local_ctx, df)
+    assert t.get_column(0).null_count() == 1
+    assert t.get_column(1).null_count() == 1
+    back = t.to_pandas()
+    assert back["a"].isna().sum() == 1
+    assert back["s"].isna().sum() == 1
+
+
+def test_column_make(local_ctx):
+    c = ct.Column.Make(local_ctx, "v", ct.dtypes.Int64(), [1, 2, 3])
+    assert len(c) == 3
+    assert c.name == "v"
+
+
+def test_temporal_roundtrip(local_ctx):
+    df = pd.DataFrame({"t": pd.date_range("2026-01-01", periods=4, freq="D")})
+    t = ct.Table.from_pandas(local_ctx, df)
+    back = t.to_pandas()
+    pd.testing.assert_frame_equal(back, df, check_dtype=False)
+
+
+def test_bad_column_raises(local_ctx):
+    t = ct.Table.from_pydict(local_ctx, {"a": [1]})
+    with pytest.raises(ct.CylonError) as e:
+        t.project(["nope"])
+    assert e.value.code == ct.Code.KeyError
