@@ -1,0 +1,84 @@
+"""Benchmark driver — distributed inner join throughput on the attached
+chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's published single-worker distributed inner join —
+200M rows in 141.5 s ≈ 1.414M rows/s/worker (reference:
+docs/docs/arch.md:152, arXiv:2007.09589; see BASELINE.md). vs_baseline is
+our rows/sec/chip over that per-worker rate.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Cylon-MPI, 1 worker: 200M-row inner join in 141.5 s (BASELINE.md)
+_BASELINE_ROWS_PER_S = 200e6 / 141.5
+
+
+def run(n_rows: int = 1 << 24, iters: int = 3) -> dict:
+    import jax
+
+    import cylon_tpu as ct
+
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        ctx = ct.CylonContext.InitDistributed(ct.TPUConfig())
+    else:
+        ctx = ct.CylonContext.Init()
+
+    rng = np.random.default_rng(0)
+    left = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n_rows, n_rows).astype(np.int32),
+        "v": rng.normal(size=n_rows).astype(np.float32),
+    })
+    right = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n_rows, n_rows).astype(np.int32),
+        "w": rng.normal(size=n_rows).astype(np.float32),
+    })
+
+    def one_join():
+        if ctx.is_distributed():
+            out = left.distributed_join(right, "inner", on="k")
+        else:
+            out = left.join(right, "inner", on="k")
+        jax.block_until_ready(out.get_column(0).data)
+        return out
+
+    one_join()  # warmup/compile
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = one_join()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+
+    total_rows = 2 * n_rows  # rows ingested by the join (both sides)
+    rows_per_s_per_chip = total_rows / best / max(ctx.get_world_size(), 1)
+    return {
+        "metric": "dist_inner_join_rows_per_sec_per_chip",
+        "value": round(rows_per_s_per_chip, 1),
+        "unit": "rows/s/chip",
+        "vs_baseline": round(rows_per_s_per_chip / _BASELINE_ROWS_PER_S, 3),
+        "detail": {
+            "n_rows_per_side": n_rows,
+            "world": ctx.get_world_size(),
+            "wall_s_best": round(best, 4),
+            "wall_s_all": [round(t, 4) for t in times],
+            "out_rows": out.row_count,
+            "backend": jax.devices()[0].platform,
+        },
+    }
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=1 << 24)
+    p.add_argument("--iters", type=int, default=3)
+    a = p.parse_args()
+    print(json.dumps(run(a.rows, a.iters)))

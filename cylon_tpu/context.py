@@ -103,9 +103,11 @@ class CylonContext:
         return jax.process_index()
 
     def get_neighbours(self, include_self: bool = False) -> List[int]:
-        """Reference: GetNeighbours (cylon_context.cpp:77-86)."""
+        """All other shard indices, optionally including this controller's
+        own (reference: GetNeighbours, cylon_context.cpp:77-86)."""
         w = self.get_world_size()
-        return [i for i in range(w) if include_self]
+        me = self.get_rank()
+        return [i for i in range(w) if include_self or i != me]
 
     def get_next_sequence(self) -> int:
         """Monotonic op id — the reference used it as the MPI comm tag
@@ -118,8 +120,8 @@ class CylonContext:
         """Synchronize all devices (reference: MPI_Barrier)."""
         if self._finalized:
             return
-        x = jax.device_put(np.zeros((), np.int32), self.devices[0])
-        jax.block_until_ready(x + 1)
+        xs = [jax.device_put(np.zeros((), np.int32), d) for d in self.devices]
+        jax.block_until_ready([x + 1 for x in xs])
 
     def finalize(self) -> None:
         self._finalized = True

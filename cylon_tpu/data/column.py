@@ -154,12 +154,12 @@ class Column:
         neg = idx < 0
         safe = jnp.where(neg, 0, idx)
         data = jnp.take(self.data, safe, axis=0)
-        valid = jnp.take(self.valid_mask(), safe, axis=0) & ~neg
         validity = None
         if fill_invalid or self.validity is not None:
-            validity = valid
-        if validity is not None and bool(validity.all()):
-            validity = None
+            # NOTE: an all-True mask is NOT collapsed to None here — that
+            # would force a device→host sync on every gather (deadly over a
+            # tunneled TPU). Export paths collapse it instead.
+            validity = jnp.take(self.valid_mask(), safe, axis=0) & ~neg
         return Column(data, self.dtype, validity, self.dictionary, self.name)
 
     def slice(self, start: int, stop: int) -> "Column":
@@ -172,16 +172,22 @@ class Column:
 
     # -- export --
 
+    def _host_mask(self) -> Optional[np.ndarray]:
+        """Validity as a host array, collapsing all-True to None."""
+        if self.validity is None:
+            return None
+        mask = np.asarray(jax.device_get(self.validity))
+        return None if mask.all() else mask
+
     def to_numpy(self) -> np.ndarray:
         data = np.asarray(jax.device_get(self.data))
+        mask = self._host_mask()
         if self.is_string:
             out = self.dictionary[data].astype(object)
-            if self.validity is not None:
-                mask = np.asarray(jax.device_get(self.validity))
+            if mask is not None:
                 out[~mask] = None
             return out
-        if self.validity is not None:
-            mask = np.asarray(jax.device_get(self.validity))
+        if mask is not None:
             if data.dtype.kind == "f":
                 out = data.astype(data.dtype, copy=True)
                 out[~mask] = np.nan
@@ -202,9 +208,8 @@ class Column:
         import pyarrow as pa
 
         data = np.asarray(jax.device_get(self.data))
-        mask = None
-        if self.validity is not None:
-            mask = ~np.asarray(jax.device_get(self.validity))
+        valid = self._host_mask()
+        mask = None if valid is None else ~valid
         if self.is_string:
             vals = self.dictionary[data]
             return pa.array(vals, type=pa.string(),

@@ -400,7 +400,7 @@ class Table:
             if key.column_count != 1:
                 # full-table mask: AND across columns? pycylon uses filter result
                 raise CylonError(Code.Invalid, "mask table must have one column")
-            mask = key._columns[0].data.astype(bool)
+            mask = key._columns[0].data.astype(bool) & key.emit_mask()
             return self.filter_mask(mask)
         if isinstance(key, slice):
             return self.slice(key.start or 0,
@@ -414,7 +414,9 @@ class Table:
         raise CylonError(Code.Invalid, f"unsupported key {key!r}")
 
     def _compare(self, other, op) -> "Table":
-        t = self.compact()
+        # keep the padded capacity + row_mask (join/dist results are padded;
+        # compacting here would break t[t["c"] > x] shape alignment)
+        t = self
         out_cols = []
         for c in t._columns:
             if c.is_string:
@@ -438,7 +440,7 @@ class Table:
                 res = _CMP[op](c.data, o)
             res = res & c.valid_mask()
             out_cols.append(Column(res, dtypes.Bool(), None, None, c.name))
-        return Table(out_cols, self._ctx)
+        return Table(out_cols, self._ctx, t.row_mask)
 
     def __eq__(self, other):  # type: ignore[override]
         if isinstance(other, Table):
@@ -469,7 +471,7 @@ class Table:
         cols = [Column(fn(a.data.astype(bool), b.data.astype(bool)),
                        dtypes.Bool(), None, None, a.name)
                 for a, b in zip(self._columns, other._columns)]
-        return Table(cols, self._ctx)
+        return Table(cols, self._ctx, self.row_mask)
 
     def __and__(self, other: "Table") -> "Table":
         return self._bool_binop(other, jnp.logical_and)
@@ -516,8 +518,7 @@ def _as_agg_op(o) -> _groupby.AggregationOp:
     return _groupby.AggregationOp(int(o))
 
 
-def _pow2(n: int) -> int:
-    return 1 if n <= 1 else 1 << (int(n) - 1).bit_length()
+from ..util import pow2 as _pow2  # shared capacity-rounding policy
 
 
 def _resolve_join_columns(left: Table, right: Table, kwargs
@@ -565,21 +566,6 @@ def align_key_columns(left: Table, right: Table, lidx: List[int],
     return lcols, rcols
 
 
-def join_gids(left: Table, right: Table, lidx: List[int], ridx: List[int]
-              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Shared dense key ids for a join; null keys get non-matching
-    sentinels (SQL semantics: NULL joins nothing)."""
-    lcols, rcols = align_key_columns(left, right, lidx, ridx)
-    keys_l = _order.sort_keys(lcols)
-    keys_r = _order.sort_keys(rcols)
-    gl, gr = _order.dense_ranks_two(keys_l, keys_r)
-    lvalid = _all_valid(lcols)
-    rvalid = _all_valid(rcols)
-    gl = jnp.where(lvalid, gl, _join.LEFT_NULL_GID)
-    gr = jnp.where(rvalid, gr, _join.RIGHT_NULL_GID)
-    return gl, gr
-
-
 def _all_valid(cols: Sequence[Column]) -> jnp.ndarray:
     v = cols[0].valid_mask()
     for c in cols[1:]:
@@ -609,27 +595,37 @@ def row_gids(left: Table, right: Table) -> Tuple[jnp.ndarray, jnp.ndarray]:
 # ---------------------------------------------------------------------------
 
 def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
-    """Local join (reference: cylon::Join, table.cpp:640-654)."""
-    gl, gr = join_gids(left, right, config.left_column_idx,
-                       config.right_column_idx)
-    lidx, ridx = _join.join_indices(gl, gr, left.emit_mask(),
-                                    right.emit_mask(), config.type)
-    return _materialize_join(left, right, lidx, ridx)
+    """Local join (reference: cylon::Join, table.cpp:640-654). Exactly TWO
+    compiled programs (count, then materialize) — only the 4 output-count
+    scalars touch the host; the result keeps pow2 capacity with padding
+    rows masked via row_mask."""
+    lcols, rcols = align_key_columns(left, right, config.left_column_idx,
+                                     config.right_column_idx)
+    str_flags = tuple(c.is_string for c in lcols)
+    lkeys = tuple(c.data for c in lcols)
+    lkvalid = tuple(c.validity for c in lcols)
+    rkeys = tuple(c.data for c in rcols)
+    rkvalid = tuple(c.validity for c in rcols)
+    lemit, remit = left.row_mask, right.row_mask
 
+    counts = _join.unpack_counts(jax.device_get(_join.count_program(
+        lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags)))
+    cap_l, cap_u = _join.caps_for(config.type, counts)
 
-def _materialize_join(left: Table, right: Table, lidx, ridx) -> Table:
-    """Gather + rename with the reference's lt-/rt- schema
-    (join_utils.cpp:47-56: fields are concatenated then prefixed by
-    originating side with their global index)."""
-    li = jnp.asarray(lidx)
-    ri = jnp.asarray(ridx)
-    cols = []
+    ldat = tuple(c.data for c in left._columns)
+    lval = tuple(c.validity for c in left._columns)
+    rdat = tuple(c.data for c in right._columns)
+    rval = tuple(c.validity for c in right._columns)
+    lod, lov, rod, rov, emit = _join.materialize_program(
+        lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
+        ldat, lval, rdat, rval, str_flags, config.type, cap_l, cap_u)
+
     nl = left.column_count
-    for i, c in enumerate(left._columns):
-        cols.append(c.take(li).rename(f"lt-{i}"))
-    for j, c in enumerate(right._columns):
-        cols.append(c.take(ri).rename(f"rt-{nl + j}"))
-    return Table(cols, left._ctx)
+    cols = [Column(d, c.dtype, v, c.dictionary, f"lt-{i}")
+            for i, (d, v, c) in enumerate(zip(lod, lov, left._columns))]
+    cols += [Column(d, c.dtype, v, c.dictionary, f"rt-{nl + j}")
+             for j, (d, v, c) in enumerate(zip(rod, rov, right._columns))]
+    return Table(cols, left._ctx, emit)
 
 
 def set_op(left: Table, right: Table, op) -> Table:

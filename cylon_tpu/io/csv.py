@@ -35,7 +35,7 @@ def _arrow_options(options: CSVReadOptions):
         double_quote=o._double_quote,
         escape_char=o._escape_char if o._escaping else False,
         newlines_in_values=o._newlines_in_values,
-        ignore_empty_lines=True if o._ignore_empty_lines else True,
+        ignore_empty_lines=bool(o._ignore_empty_lines),
     )
     convert_kwargs = dict(
         check_utf8=True,

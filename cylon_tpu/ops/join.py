@@ -7,9 +7,11 @@ arrow_hash_kernels.hpp:48-225) with ONE TPU-idiomatic algorithm:
 
 1. key columns of both tables are mapped to shared dense integer ids
    (ops/order.dense_ranks_two — a single fused device sort);
-2. the right ids are sorted once; per-left-row match ranges come from two
-   vectorized ``searchsorted`` calls; duplicate expansion uses prefix sums
-   (the reference's `advance` duplicate-run loops become gathers);
+2. because the ids are DENSE, per-left-row match ranges come from one
+   fused sort + prefix-scans (`_match_lo_m`) and duplicate expansion from
+   run-head scatters + cumsum + gathers — no binary search, no
+   duplicate-index scatter, no cumulative max (all three are TPU
+   pathologies; see the kernel-block comment below);
 3. output size is data-dependent, so materialization is two-phase
    (count → allocate static capacity → gather), the XLA static-shape
    discipline described in SURVEY §7.
@@ -31,6 +33,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..util import pow2 as _pow2
 
 
 class JoinType(enum.IntEnum):
@@ -100,16 +104,85 @@ def _as_list(v):
 #   gl, gr : int32 dense key ids on a shared id space (>= 0); rows whose key
 #            must never match carry a negative sentinel (-1 left, -2 right).
 #   lemit, remit : bool masks — rows eligible for emission (False for padding).
+#
+# NO jnp.searchsorted anywhere: its binary-search lowering is pathologically
+# slow on TPU (measured ~4 s per 16M×16M call vs 0.14 s for a full sort).
+# Equally banned: duplicate-index scatters (segment_sum over gid buckets —
+# minutes at 16M) and associative_scan(maximum) (215 s COMPILE at 2M).
+# Everything below is sorts, cumsums, gathers and unique-index scatters.
 # ---------------------------------------------------------------------------
 
 LEFT_NULL_GID = np.int32(-1)
 RIGHT_NULL_GID = np.int32(-2)
+# Emit-mask sentinels are DISTINCT from the null sentinels: kernels like
+# _expand_pairs are called with sides swapped for RIGHT joins, so a masked
+# first-arg row re-tagged with LEFT_NULL_GID would collide with a null-key
+# row of the true left table (already −1 from compute_gids). −3/−4 can
+# never equal a real gid (≥0) or a null sentinel on either side.
+_MASKED_A_GID = np.int32(-3)
+_MASKED_B_GID = np.int32(-4)
 
 
-def _match_ranges(gl, gr_sorted):
-    lo = jnp.searchsorted(gr_sorted, gl, side="left")
-    hi = jnp.searchsorted(gr_sorted, gl, side="right")
-    return lo, hi - lo
+def _mask_gids(ga, gb, aemit, bemit):
+    """Non-emitted rows (padding, filtered) must not act as match PARTNERS
+    either — give them positional sentinels that match nothing."""
+    return (jnp.where(aemit, ga, _MASKED_A_GID),
+            jnp.where(bemit, gb, _MASKED_B_GID))
+
+
+def _match_lo_m(ga, gb) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-a-row match info against b: lo[i] = #b-rows with gid < ga[i]
+    (= start of the equal-gid run in gid-sorted b order), m[i] = #b-rows
+    with gid == ga[i].
+
+    One fused 3-operand sort with b ordered BEFORE a inside each gid run,
+    so at every a position the inclusive b-prefix count minus the count at
+    the run start IS the run's b total. Scatter-backs hit unique
+    destinations (TPU serializes duplicate-index scatters; segment_sum over
+    a gid-sized bucket array was measured minutes-slow at 16M rows —
+    everything here is sort/scan/gather/unique-scatter).
+    Sentinel gids (negative, side-distinct) never match across sides."""
+    na, nb = ga.shape[0], gb.shape[0]
+    n = na + nb
+    if n == 0 or na == 0:
+        return jnp.zeros(na, jnp.int32), jnp.zeros(na, jnp.int32)
+    g = jnp.concatenate([ga, gb])
+    side = jnp.concatenate([jnp.ones(na, jnp.int32),
+                            jnp.zeros(nb, jnp.int32)])
+    iota = jnp.arange(n, dtype=jnp.int32)
+    g_s, side_s, idx_s = jax.lax.sort((g, side, iota), num_keys=2)
+    is_b = side_s == 0
+    cum_b = jnp.cumsum(is_b.astype(jnp.int32))  # inclusive prefix b-count
+    neq = jnp.zeros(n, bool).at[0].set(True)
+    neq = neq.at[1:].set(g_s[1:] != g_s[:-1])
+    # run_start[p] = position of p's run head. NOT a cumulative max —
+    # associative_scan(maximum) compiles catastrophically slowly on TPU
+    # (measured 215 s compile at 2M rows); run ids are cumsum(neq), run
+    # heads scatter to unique slots, and a gather broadcasts them back.
+    run_id = jnp.cumsum(neq.astype(jnp.int32)) - 1
+    first_pos = jnp.zeros(n, jnp.int32).at[
+        jnp.where(neq, run_id, n)].set(iota, mode="drop")
+    run_start = jnp.take(first_pos, run_id)
+    b_before = jnp.take(cum_b, run_start) - \
+        jnp.take(is_b.astype(jnp.int32), run_start)
+    m_at = cum_b - b_before  # valid at a positions: run b's all precede
+    dest = jnp.where(is_b, na, idx_s)
+    lo = jnp.zeros(na, jnp.int32).at[dest].set(b_before, mode="drop")
+    m = jnp.zeros(na, jnp.int32).at[dest].set(m_at, mode="drop")
+    return lo, m
+
+
+def _masked_indices(mask, out_size: int) -> jnp.ndarray:
+    """Positions of True values in order, padded with −1 to out_size.
+    Sort-based (stable sort by ~mask) — jnp.nonzero's lowering is scatter-
+    heavy and ignores fill_value on empty operands."""
+    n = mask.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    _, srt = jax.lax.sort(((~mask).astype(jnp.int32), iota), num_keys=1)
+    cnt = mask.sum()
+    j = jnp.arange(out_size, dtype=jnp.int32)
+    idx = jnp.take(srt, j, mode="fill", fill_value=0)
+    return jnp.where(j < cnt, idx, -1).astype(jnp.int32)
 
 
 @jax.jit
@@ -118,12 +191,9 @@ def join_counts(gl, gr, lemit, remit):
 
     Returns dict of int32 scalars: n_inner, n_left, n_right, n_full.
     """
-    gr_sorted = jnp.sort(gr)
-    _, m = _match_ranges(gl, gr_sorted)
-    m = jnp.where(lemit, m, 0)
-    gl_sorted = jnp.sort(gl)
-    _, mr = _match_ranges(gr, gl_sorted)
-    mr = jnp.where(remit, mr, 0)
+    gl, gr = _mask_gids(gl, gr, lemit, remit)
+    _, m = _match_lo_m(gl, gr)
+    _, mr = _match_lo_m(gr, gl)
     n_inner = m.sum()
     n_left = jnp.where(lemit, jnp.maximum(m, 1), 0).sum()
     n_right = jnp.where(remit, jnp.maximum(mr, 1), 0).sum()
@@ -140,29 +210,50 @@ def join_counts(gl, gr, lemit, remit):
 def _expand_pairs(gl, gr, lemit, remit, out_size: int,
                   emit_unmatched_left: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Emit (left_idx, right_idx) pairs for INNER (emit_unmatched_left=False)
-    or LEFT join (True), padded to ``out_size`` with (-1, -1)."""
+    or LEFT join (True), padded to ``out_size`` with (-1, -1).
+
+    Right rows of gid g occupy a contiguous run [start_r[g], start_r[g]+
+    cnt_r[g]) of the gid-sorted right permutation; left row i's j-th output
+    picks run slot k = j - first_output_of_i. The j→i map is materialized by
+    scattering each emitting row's index at its first output slot and
+    taking a cumulative max (duplicate-run expansion with no search)."""
+    gl, gr = _mask_gids(gl, gr, lemit, remit)
     nl, nr = gl.shape[0], gr.shape[0]
     if nl == 0:
         e = jnp.full(out_size, -1, jnp.int32)
         return e, e
+    lo, m = _match_lo_m(gl, gr)
     riota = jnp.arange(nr, dtype=jnp.int32)
-    gr_sorted, rperm = jax.lax.sort((gr, riota), num_keys=1)
-    lo, m = _match_ranges(gl, gr_sorted)
-    m = jnp.where(lemit, m, 0)
+    _, rperm = jax.lax.sort((gr, riota), num_keys=1)
+    # gr-sorted order puts sentinel (-2) rows FIRST; `lo` counts them too
+    # (#b with smaller gid), so run positions stay consistent
     mm = jnp.where(lemit & emit_unmatched_left, jnp.maximum(m, 1), m)
     off = jnp.cumsum(mm)
-    total = off[-1] if nl > 0 else jnp.int32(0)
+    total = off[-1]
+    starts = off - mm
+
+    liota = jnp.arange(nl, dtype=jnp.int32)
+    # j → emitting-row map without a cumulative max (associative_scan(max)
+    # compiles catastrophically slowly on TPU): scatter a 1 at each run
+    # start (unique slots), cumsum ranks each output position into its
+    # ordinal emitting run, and a gather through the compacted emitting-row
+    # list recovers the row index.
+    erank = jnp.cumsum((mm > 0).astype(jnp.int32))  # inclusive
+    emit_list = jnp.zeros(nl, jnp.int32).at[
+        jnp.where(mm > 0, erank - 1, nl)].set(liota, mode="drop")
+    z = jnp.zeros(out_size, jnp.int32)
+    z = z.at[jnp.where(mm > 0, starts, out_size)].set(1, mode="drop")
+    c = jnp.cumsum(z)  # 1-based ordinal of the run covering position j
+    i = jnp.take(emit_list, jnp.maximum(c - 1, 0), mode="clip")
+
     j = jnp.arange(out_size, dtype=jnp.int32)
-    i = jnp.searchsorted(off, j, side="right").astype(jnp.int32)
-    i = jnp.minimum(i, max(nl - 1, 0))
-    start = off[i] - mm[i]
-    k = j - start
-    rpos = lo[i] + k
+    k = j - jnp.take(starts, i)
+    rpos = jnp.take(lo, i) + k
     if nr == 0:
         ridx = jnp.full(out_size, -1, jnp.int32)
     else:
         ridx = jnp.take(rperm, rpos, mode="fill", fill_value=0)
-        ridx = jnp.where(m[i] > 0, ridx, -1)
+        ridx = jnp.where(jnp.take(m, i) > 0, ridx, -1)
     valid = j < total
     lidx = jnp.where(valid, i, -1)
     ridx = jnp.where(valid, ridx, -1)
@@ -172,62 +263,132 @@ def _expand_pairs(gl, gr, lemit, remit, out_size: int,
 @partial(jax.jit, static_argnames=("out_size",))
 def _unmatched_right(gl, gr, lemit, remit, out_size: int) -> jnp.ndarray:
     """Right rows with no left match, padded to out_size with -1."""
-    gl_sorted = jnp.sort(gl)
-    _, mr = _match_ranges(gr, gl_sorted)
+    if gr.shape[0] == 0:
+        return jnp.full(out_size, -1, jnp.int32)
+    gl, gr = _mask_gids(gl, gr, lemit, remit)
+    _, mr = _match_lo_m(gr, gl)
     un = remit & (mr == 0)
-    (idx,) = jnp.nonzero(un, size=out_size, fill_value=-1)
-    return idx.astype(jnp.int32)
+    return _masked_indices(un, out_size)
 
 
-def join_indices(gl, gr, lemit=None, remit=None,
-                 join_type: JoinType = JoinType.INNER,
-                 counts: Optional[dict] = None
-                 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Eager driver: count on device, sync the scalar, materialize with a
-    pow2-rounded static capacity (bounds recompilation), slice to the true
-    size. Returns host int32 index arrays (−1 = null row, the reference's
-    convention in join_utils.cpp:131-196)."""
-    nl, nr = gl.shape[0], gr.shape[0]
-    if lemit is None:
-        lemit = jnp.ones(nl, dtype=bool)
-    if remit is None:
-        remit = jnp.ones(nr, dtype=bool)
-    if counts is None:
-        counts = {k: int(v) for k, v in join_counts(gl, gr, lemit, remit).items()}
-
-    if join_type == JoinType.RIGHT:
-        ridx, lidx = join_indices(gr, gl, remit, lemit, JoinType.LEFT,
-                                  _swap_counts(counts))
-        return lidx, ridx
-
+def caps_for(join_type: JoinType, counts: dict) -> Tuple[int, int]:
+    """Static (primary, unmatched-right) output capacities for a type."""
     if join_type == JoinType.INNER:
-        total = counts["n_inner"]
-        cap = _pow2(total)
-        lidx, ridx = _expand_pairs(gl, gr, lemit, remit, cap, False)
-        return np.asarray(lidx)[:total], np.asarray(ridx)[:total]
-
+        return _pow2(counts["n_inner"]), 0
     if join_type == JoinType.LEFT:
-        total = counts["n_left"]
-        cap = _pow2(total)
-        lidx, ridx = _expand_pairs(gl, gr, lemit, remit, cap, True)
-        return np.asarray(lidx)[:total], np.asarray(ridx)[:total]
-
-    # FULL_OUTER = LEFT part + unmatched right
-    n_left = counts["n_left"]
-    n_un = counts["n_full"] - n_left
-    lidx, ridx = _expand_pairs(gl, gr, lemit, remit, _pow2(n_left), True)
-    un = _unmatched_right(gl, gr, lemit, remit, _pow2(n_un))
-    lidx = np.concatenate([np.asarray(lidx)[:n_left],
-                           np.full(n_un, -1, np.int32)])
-    ridx = np.concatenate([np.asarray(ridx)[:n_left], np.asarray(un)[:n_un]])
-    return lidx, ridx
+        return _pow2(counts["n_left"]), 0
+    if join_type == JoinType.RIGHT:
+        return _pow2(counts["n_right"]), 0
+    return (_pow2(counts["n_left"]),
+            _pow2(counts["n_full"] - counts["n_left"]))
 
 
-def _swap_counts(c: dict) -> dict:
-    # n_full = n_inner + unmatched_left + unmatched_right is side-symmetric.
-    return {"n_inner": c["n_inner"], "n_left": c["n_right"],
-            "n_right": c["n_left"], "n_full": c["n_full"]}
+def join_pairs_static(gl, gr, lemit, remit, join_type: JoinType,
+                      cap_l: int, cap_u: int
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Traceable (lidx, ridx, emit) at static capacity — shared by the
+    local fused programs and the per-shard distributed kernels. emit marks
+    live output rows; padding slots carry (-1, -1, False)."""
+    if join_type == JoinType.RIGHT:
+        ridx, lidx = _expand_pairs(gr, gl, remit, lemit, cap_l, True)
+    elif join_type == JoinType.INNER:
+        lidx, ridx = _expand_pairs(gl, gr, lemit, remit, cap_l, False)
+    elif join_type == JoinType.LEFT:
+        lidx, ridx = _expand_pairs(gl, gr, lemit, remit, cap_l, True)
+    else:  # FULL_OUTER = LEFT part + unmatched right
+        lidx, ridx = _expand_pairs(gl, gr, lemit, remit, cap_l, True)
+        un = _unmatched_right(gl, gr, lemit, remit, cap_u)
+        lidx = jnp.concatenate([lidx, jnp.full(un.shape, -1, jnp.int32)])
+        ridx = jnp.concatenate([ridx, un])
+    return lidx, ridx, (lidx >= 0) | (ridx >= 0)
 
 
-def _pow2(n: int) -> int:
-    return 1 if n <= 1 else 1 << (int(n) - 1).bit_length()
+# ---------------------------------------------------------------------------
+# Fused whole-join programs. The eager per-op path costs one dispatch per
+# jnp call — ruinous over a tunneled TPU — so the local join is exactly TWO
+# compiled programs: count (→ one 4-scalar host sync) and materialize.
+# ---------------------------------------------------------------------------
+
+_COUNT_KEYS = ("n_inner", "n_left", "n_right", "n_full")
+
+
+def _vm(v, n):
+    """validity-or-None → mask (None means all-valid; stays device-side)."""
+    return jnp.ones(n, dtype=bool) if v is None else v
+
+
+def compute_gids(lbits, lkv, rbits, rkv):
+    """Shared dense key ids with null sentinels (traceable; shared by the
+    fused local programs and the per-shard distributed kernels)."""
+    from .order import dense_ranks_two
+
+    gl, gr = dense_ranks_two(list(lbits), list(rbits))
+    return (jnp.where(lkv, gl, LEFT_NULL_GID),
+            jnp.where(rkv, gr, RIGHT_NULL_GID))
+
+
+def _keys_to_gids(lkeys, lkvalid, rkeys, rkvalid, str_flags):
+    from .order import ordered_bits_raw
+
+    n_l, n_r = lkeys[0].shape[0], rkeys[0].shape[0]
+    lbits = tuple(ordered_bits_raw(x, s) for x, s in zip(lkeys, str_flags))
+    rbits = tuple(ordered_bits_raw(x, s) for x, s in zip(rkeys, str_flags))
+    lkv = jnp.ones(n_l, bool)
+    for v in lkvalid:
+        if v is not None:
+            lkv = lkv & v
+    rkv = jnp.ones(n_r, bool)
+    for v in rkvalid:
+        if v is not None:
+            rkv = rkv & v
+    return compute_gids(lbits, lkv, rbits, rkv)
+
+
+@partial(jax.jit, static_argnames=("str_flags",))
+def count_program(lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags):
+    """Phase 1: everything from raw key columns to the 4 output counts in
+    one compiled program."""
+    gl, gr = _keys_to_gids(lkeys, lkvalid, rkeys, rkvalid, str_flags)
+    c = join_counts(gl, gr, _vm(lemit, gl.shape[0]), _vm(remit, gr.shape[0]))
+    return jnp.stack([c[k] for k in _COUNT_KEYS])
+
+
+@partial(jax.jit,
+         static_argnames=("str_flags", "join_type", "cap_l", "cap_u"))
+def materialize_program(lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
+                        ldat, lval, rdat, rval,
+                        str_flags, join_type: JoinType, cap_l: int,
+                        cap_u: int):
+    """Phase 2: gids → index pairs → gather every payload column, one
+    compiled program. Returns (ldat', lval', rdat', rval', emit)."""
+    gl, gr = _keys_to_gids(lkeys, lkvalid, rkeys, rkvalid, str_flags)
+    lemit = _vm(lemit, gl.shape[0])
+    remit = _vm(remit, gr.shape[0])
+    lidx, ridx, emit = join_pairs_static(gl, gr, lemit, remit, join_type,
+                                         cap_l, cap_u)
+    lod, lov = gather_columns(ldat, lval, lidx)
+    rod, rov = gather_columns(rdat, rval, ridx)
+    return lod, lov, rod, rov, emit
+
+
+def gather_columns(dat, val, idx):
+    """Batch −1→null gather (traceable): new validity = src validity at the
+    gathered row AND a real (non-negative) index. Empty sources produce
+    all-null outputs (idx is guaranteed all −1 then)."""
+    safe = jnp.maximum(idx, 0)
+    hit = idx >= 0
+    out_d, out_v = [], []
+    for d, v in zip(dat, val):
+        if d.shape[0] == 0:
+            out_d.append(jnp.zeros(idx.shape + d.shape[1:], d.dtype))
+            out_v.append(jnp.zeros(idx.shape, bool))
+        else:
+            out_d.append(jnp.take(d, safe, axis=0))
+            out_v.append(hit if v is None else (jnp.take(v, safe) & hit))
+    return tuple(out_d), tuple(out_v)
+
+
+def unpack_counts(counts_arr) -> dict:
+    return {k: int(v) for k, v in zip(_COUNT_KEYS, counts_arr)}
+
+

@@ -29,7 +29,14 @@ _WIDTH_UINT = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
 
 
 def ordered_bits(col: Column, descending: bool = False) -> jnp.ndarray:
-    """Map a column's values to unsigned ints preserving value order.
+    """Column wrapper over `ordered_bits_raw`."""
+    return ordered_bits_raw(col.data, col.is_string, descending)
+
+
+def ordered_bits_raw(x: jnp.ndarray, is_string: bool = False,
+                     descending: bool = False) -> jnp.ndarray:
+    """Map values to unsigned ints preserving value order (traceable —
+    usable inside jit/shard_map programs).
 
     * unsigned ints: identity
     * signed ints: flip the sign bit
@@ -41,8 +48,7 @@ def ordered_bits(col: Column, descending: bool = False) -> jnp.ndarray:
 
     Nulls are NOT handled here — callers combine with ``valid_mask``.
     """
-    x = col.data
-    if col.is_string:
+    if is_string:
         out = x.astype(jnp.uint32)
     else:
         dt = x.dtype

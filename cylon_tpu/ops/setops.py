@@ -4,8 +4,8 @@ Replaces the reference's hash-set implementation (reference:
 cpp/src/cylon/table.cpp:39-942 — `RowComparator` over an
 `unordered_set<pair<tableIdx,rowIdx>>`, arrow_comparator.cpp) with sorted
 dense ranks: both tables' rows map to shared integer ids (one fused device
-sort), then membership is ``searchsorted`` and dedup is a first-occurrence
-mask — no pointer-chasing hash sets, all vectorized.
+sort), then membership is a segment-count gather and dedup is a
+first-occurrence mask — no pointer-chasing hash sets, all vectorized.
 
 Set semantics match the reference: results are DISTINCT rows; within-table
 duplicates collapse. Null row-components compare equal to each other (ids
@@ -66,12 +66,13 @@ def _first_occurrence(g) -> jnp.ndarray:
 def _isin(g, other, other_emit) -> jnp.ndarray:
     """Membership of each id of ``g`` in ``other`` (emitted rows only).
     ``other`` must already carry a sentinel on non-emitted rows that can
-    never appear in ``g``."""
+    never appear in ``g``. Sort+scan match counting — no searchsorted, no
+    duplicate-index scatters (both pathologically slow on TPU)."""
     del other_emit  # sentinel handling is done by the caller
-    os = jnp.sort(other)
-    lo = jnp.searchsorted(os, g, side="left")
-    hi = jnp.searchsorted(os, g, side="right")
-    return hi > lo
+    from .join import _match_lo_m
+
+    _, m = _match_lo_m(g, other)
+    return m > 0
 
 
 @partial(jax.jit, static_argnames=("op", "out_size"))
@@ -83,6 +84,9 @@ def setop_indices(gl, gr, lemit, remit, op: SetOp, out_size: int
     left row, i >= nl selects right row i-nl (only UNION emits those).
     """
     nl = gl.shape[0]
+    if nl + gr.shape[0] == 0:
+        return jnp.full(out_size, -1, jnp.int32)
+    from .join import _masked_indices
     gl_eff = jnp.where(lemit, gl, -1)
     gr_eff = jnp.where(remit, gr, -2)
     first_l = _first_occurrence(gl_eff) & lemit
@@ -97,8 +101,7 @@ def setop_indices(gl, gr, lemit, remit, op: SetOp, out_size: int
     else:  # INTERSECT
         in_r = _isin(gl_eff, gr_eff, remit)
         mask = jnp.concatenate([first_l & in_r, jnp.zeros_like(remit)])
-    (idx,) = jnp.nonzero(mask, size=out_size, fill_value=-1)
-    return idx.astype(jnp.int32)
+    return _masked_indices(mask, out_size)
 
 
 def setop_rows(gl, gr, lemit, remit, op: SetOp) -> np.ndarray:
@@ -106,6 +109,8 @@ def setop_rows(gl, gr, lemit, remit, op: SetOp) -> np.ndarray:
     counts = {k: int(v) for k, v in setop_counts(gl, gr, lemit, remit).items()}
     total = counts[{SetOp.UNION: "n_union", SetOp.SUBTRACT: "n_subtract",
                     SetOp.INTERSECT: "n_intersect"}[op]]
-    cap = 1 if total <= 1 else 1 << (total - 1).bit_length()
+    from ..util import pow2
+
+    cap = pow2(total)
     idx = setop_indices(gl, gr, lemit, remit, op, cap)
     return np.asarray(idx)[:total]

@@ -1,0 +1,19 @@
+"""Distributed layer: sharding, shuffle (XLA all_to_all), distributed ops.
+
+Replaces the reference's net/ + arrow-comm stack (reference:
+cpp/src/cylon/net/, cpp/src/cylon/arrow/arrow_all_to_all.cpp) with compiled
+SPMD programs over a `jax.sharding.Mesh`.
+"""
+from . import dist_ops, shard, shuffle
+from .dist_ops import (distributed_groupby, distributed_join,
+                       distributed_set_op, distributed_sort, hash_partition,
+                       repartition)
+from .dist_ops import shuffle as shuffle_table
+from .shard import distribute, is_distributed_table, row_sharding
+
+__all__ = [
+    "dist_ops", "distribute", "distributed_groupby", "distributed_join",
+    "distributed_set_op", "distributed_sort", "hash_partition",
+    "is_distributed_table", "repartition", "row_sharding", "shard",
+    "shuffle", "shuffle_table",
+]

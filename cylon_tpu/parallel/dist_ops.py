@@ -1,0 +1,434 @@
+"""Distributed relational operators: shuffle-composed, per-shard kernels.
+
+The reference composes every distributed op as *local partition + all-to-all
++ local op* (reference: docs/docs/arch.md:48-52; DistributedJoin
+table.cpp:656-696; set ops table.cpp:948-992; GroupBy
+groupby/groupby.cpp:96-139). The same composition here, but each stage is a
+compiled SPMD program over the mesh instead of per-rank C++:
+
+  1. key prep runs on the GLOBAL sharded arrays (elementwise → no comms):
+     dtype promotion / dictionary unification, order-preserving key bits,
+     murmur-style partition targets;
+  2. the shuffle is the two-phase count+exchange from parallel/shuffle.py;
+  3. the local stage runs per shard inside `shard_map` — matching keys are
+     co-located after the hash shuffle, so per-shard dense ranks + the same
+     vectorized kernels as the local path produce the distributed result.
+
+Data-dependent output sizes follow the framework-wide eager discipline:
+a count kernel returns per-shard totals, the host picks a pow2 capacity
+(bounding recompilation), a materialize kernel fills static-shape outputs
+whose padding rows carry emit=False. Results stay sharded; nothing is
+gathered to the host.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+try:  # jax>=0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from .. import dtypes
+from ..context import CylonContext
+from ..data import table as table_mod
+from ..data.column import Column, unify_dictionaries
+from ..data.table import Table
+from ..ops import groupby as _groupby
+from ..ops import hash as _hash
+from ..ops import join as _join
+from ..ops import order as _order
+from ..ops import setops as _setops
+from ..status import Code, CylonError
+from . import shard
+from .shuffle import exchange, _pow2
+
+
+# ---------------------------------------------------------------------------
+# payload plumbing
+# ---------------------------------------------------------------------------
+
+def _table_payload(t: Table) -> dict:
+    p = {}
+    for i, c in enumerate(t._columns):
+        p[f"d{i}"] = c.data
+        p[f"v{i}"] = c.valid_mask()
+    return p
+
+
+def _payload_tuples(p: dict, ncols: int) -> Tuple[Tuple, Tuple]:
+    return (tuple(p[f"d{i}"] for i in range(ncols)),
+            tuple(p[f"v{i}"] for i in range(ncols)))
+
+
+def _rebuild_columns(dat: Sequence, val: Sequence, src: Table,
+                     names: Sequence[str]) -> List[Column]:
+    cols = []
+    for d, v, c, name in zip(dat, val, src._columns, names):
+        cols.append(Column(d, c.dtype, v, c.dictionary, name))
+    return cols
+
+
+def _all_valid(cols: Sequence[Column]) -> jnp.ndarray:
+    v = cols[0].valid_mask()
+    for c in cols[1:]:
+        v = v & c.valid_mask()
+    return v
+
+
+# ---------------------------------------------------------------------------
+# per-shard kernels (cached per mesh/static-shape signature)
+# ---------------------------------------------------------------------------
+
+# per-shard shared dense key ids with null sentinels
+_shard_gids = _join.compute_gids
+
+
+@lru_cache(maxsize=None)
+def _join_count_fn(mesh):
+    spec = P(mesh.axis_names[0])
+
+    def kernel(lbits, lkv, lemit, rbits, rkv, remit):
+        gl, gr = _shard_gids(lbits, lkv, rbits, rkv)
+        c = _join.join_counts(gl, gr, lemit, remit)
+        return jnp.stack([c["n_inner"], c["n_left"], c["n_right"],
+                          c["n_full"]]).astype(jnp.int32)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
+                             out_specs=spec))
+
+
+_gather_side = _join.gather_columns
+
+
+@lru_cache(maxsize=None)
+def _join_mat_fn(mesh, join_type: _join.JoinType, cap_l: int, cap_u: int):
+    spec = P(mesh.axis_names[0])
+
+    def kernel(lbits, lkv, lemit, rbits, rkv, remit, ldat, lval, rdat, rval):
+        gl, gr = _shard_gids(lbits, lkv, rbits, rkv)
+        lidx, ridx, emit = _join.join_pairs_static(gl, gr, lemit, remit,
+                                                   join_type, cap_l, cap_u)
+        lod, lov = _gather_side(ldat, lval, lidx)
+        rod, rov = _gather_side(rdat, rval, ridx)
+        return lod, lov, rod, rov, emit
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 10,
+                             out_specs=spec))
+
+
+@lru_cache(maxsize=None)
+def _setop_count_fn(mesh):
+    spec = P(mesh.axis_names[0])
+
+    def kernel(lbits, lemit, rbits, remit):
+        gl, gr = _order.dense_ranks_two(list(lbits), list(rbits))
+        c = _setops.setop_counts(gl, gr, lemit, remit)
+        return jnp.stack([c["n_union"], c["n_subtract"],
+                          c["n_intersect"]]).astype(jnp.int32)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 4,
+                             out_specs=spec))
+
+
+@lru_cache(maxsize=None)
+def _setop_mat_fn(mesh, op: _setops.SetOp, cap: int):
+    spec = P(mesh.axis_names[0])
+
+    def kernel(lbits, lemit, rbits, remit, ldat, lval, rdat, rval):
+        gl, gr = _order.dense_ranks_two(list(lbits), list(rbits))
+        idx = _setops.setop_indices(gl, gr, lemit, remit, op, cap)
+        emit = idx >= 0
+        # indices address the concatenated [left; right] per-shard table
+        dat = tuple(jnp.concatenate([a, b]) for a, b in zip(ldat, rdat))
+        val = tuple(jnp.concatenate([a, b]) for a, b in zip(lval, rval))
+        od, ov = _gather_side(dat, val, idx)
+        return od, ov, emit
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 8,
+                             out_specs=spec))
+
+
+@lru_cache(maxsize=None)
+def _groupby_fn(mesh, ops: Tuple[_groupby.AggregationOp, ...]):
+    spec = P(mesh.axis_names[0])
+
+    def kernel(kbits, kdat, kval, emit, vdat, vval):
+        n = emit.shape[0]
+        keys = list(kbits) + [v.astype(jnp.uint8) for v in kval]
+        gid, _ = _order.dense_ranks(keys)
+        rep, gvalid, results = _groupby.segment_aggregate(
+            gid, vdat, vval, emit, n, ops)
+        safe = jnp.minimum(rep, n - 1)
+        kout = tuple(jnp.take(d, safe, axis=0) for d in kdat)
+        kvout = tuple(jnp.take(v, safe) & gvalid for v in kval)
+        agg = tuple((arr, av & gvalid) for arr, av in results)
+        return kout, kvout, gvalid, agg
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
+                             out_specs=spec))
+
+
+# ---------------------------------------------------------------------------
+# shuffle / partition public API
+# ---------------------------------------------------------------------------
+
+def shuffle(table: Table, hash_columns: Sequence) -> Table:
+    """Repartition rows by key hash (reference: cylon::Shuffle,
+    table.cpp:162-236)."""
+    ctx = table._ctx
+    world = ctx.get_world_size()
+    if world == 1:
+        return table
+    t = shard.distribute(table, ctx)
+    idxs = [t._col_index(c) for c in hash_columns]
+    targets = shard.pin(_hash.partition_targets(
+        [t._columns[i] for i in idxs], world), ctx)
+    emit = shard.pin(t.emit_mask(), ctx)
+    payload = {k: shard.pin(v, ctx) for k, v in _table_payload(t).items()}
+    out, new_emit, _cap = exchange(payload, targets, emit, ctx)
+    dat, val = _payload_tuples(out, t.column_count)
+    cols = _rebuild_columns(dat, val, t, t.column_names)
+    return Table(cols, ctx, new_emit)
+
+
+def hash_partition(table: Table, hash_columns: Sequence,
+                   num_partitions: int) -> dict:
+    """Split into a {partition_id: Table} map (reference: HashPartition,
+    table.hpp:354, table.cpp:102-160)."""
+    idxs = [table._col_index(c) for c in hash_columns]
+    t = table.compact()
+    targets = np.asarray(jax.device_get(_hash.partition_targets(
+        [t._columns[i] for i in idxs], num_partitions)))
+    out = {}
+    for p in range(num_partitions):
+        out[p] = t.filter_mask(jnp.asarray(targets == p))
+    return out
+
+
+def repartition(table: Table, ctx: CylonContext) -> Table:
+    """Round-robin balance rows across shards (no key)."""
+    t = shard.distribute(table, ctx)
+    world = ctx.get_world_size()
+    n = t.capacity
+    targets = shard.pin(
+        jnp.arange(n, dtype=jnp.int32) % world, ctx)
+    payload = {k: shard.pin(v, ctx) for k, v in _table_payload(t).items()}
+    out, new_emit, _ = exchange(payload, targets, shard.pin(t.emit_mask(), ctx),
+                                ctx)
+    dat, val = _payload_tuples(out, t.column_count)
+    return Table(_rebuild_columns(dat, val, t, t.column_names), ctx, new_emit)
+
+
+# ---------------------------------------------------------------------------
+# distributed join (reference: DistributedJoin, table.cpp:656-696)
+# ---------------------------------------------------------------------------
+
+def distributed_join(left: Table, right: Table, config: _join.JoinConfig
+                     ) -> Table:
+    ctx = left._ctx
+    world = ctx.get_world_size()
+    if world == 1:
+        # reference parity: world==1 short-circuits to the local join
+        # (table.cpp:662-669)
+        return table_mod.join(left, right, config)
+
+    left_d = shard.distribute(left, ctx)
+    right_d = shard.distribute(right, ctx)
+    lidx, ridx = config.left_column_idx, config.right_column_idx
+    lcols, rcols = table_mod.align_key_columns(left_d, right_d, lidx, ridx)
+
+    shuffled = []
+    for t, kcols in ((left_d, lcols), (right_d, rcols)):
+        targets = shard.pin(_hash.partition_targets(kcols, world), ctx)
+        bits = _order.sort_keys(kcols)
+        kv = _all_valid(kcols)
+        payload = _table_payload(t)
+        for j, b in enumerate(bits):
+            payload[f"k{j}"] = b
+        payload["kv"] = kv
+        payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
+        out, emit, _cap = exchange(payload, targets,
+                                   shard.pin(t.emit_mask(), ctx), ctx)
+        kbits = tuple(out[f"k{j}"] for j in range(len(bits)))
+        dat, val = _payload_tuples(out, t.column_count)
+        shuffled.append((kbits, out["kv"], emit, dat, val))
+
+    (lkb, lkv, lemit, ldat, lval), (rkb, rkv, remit, rdat, rval) = shuffled
+
+    counts = np.asarray(jax.device_get(_join_count_fn(ctx.mesh)(
+        lkb, lkv, lemit, rkb, rkv, remit))).reshape(world, 4)
+    n_inner, n_left, n_right, n_full = (counts[:, 0], counts[:, 1],
+                                        counts[:, 2], counts[:, 3])
+    jt = config.type
+    if jt == _join.JoinType.INNER:
+        cap_l, cap_u = _pow2(int(n_inner.max())), 0
+    elif jt == _join.JoinType.LEFT:
+        cap_l, cap_u = _pow2(int(n_left.max())), 0
+    elif jt == _join.JoinType.RIGHT:
+        cap_l, cap_u = _pow2(int(n_right.max())), 0
+    else:
+        cap_l = _pow2(int(n_left.max()))
+        cap_u = _pow2(int((n_full - n_left).max()))
+
+    lod, lov, rod, rov, emit = _join_mat_fn(ctx.mesh, jt, cap_l, cap_u)(
+        lkb, lkv, lemit, rkb, rkv, remit, ldat, lval, rdat, rval)
+
+    nl = left_d.column_count
+    cols = _rebuild_columns(lod, lov, left_d,
+                            [f"lt-{i}" for i in range(nl)])
+    cols += _rebuild_columns(rod, rov, right_d,
+                             [f"rt-{nl + j}" for j in range(right_d.column_count)])
+    return Table(cols, ctx, emit)
+
+
+# ---------------------------------------------------------------------------
+# distributed set ops (reference: DistributedUnion/Subtract/Intersect,
+# table.cpp:948-1010 — ShuffleTwoTables on ALL columns + local set op)
+# ---------------------------------------------------------------------------
+
+def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
+    ctx = left._ctx
+    world = ctx.get_world_size()
+    if world == 1:
+        return table_mod.set_op(left, right, op)
+    if left.column_count != right.column_count:
+        raise CylonError(Code.Invalid, "set ops need equal schemas")
+
+    left_d = shard.distribute(left, ctx)
+    right_d = shard.distribute(right, ctx)
+    all_idx = list(range(left_d.column_count))
+    lcols, rcols = table_mod.align_key_columns(left_d, right_d, all_idx, all_idx)
+
+    has_validity = [a.validity is not None or b.validity is not None
+                    for a, b in zip(lcols, rcols)]
+
+    shuffled = []
+    for cols in (lcols, rcols):
+        t_emit = (left_d if cols is lcols else right_d).emit_mask()
+        targets = shard.pin(_hash.partition_targets(cols, world), ctx)
+        payload = {}
+        nbits = 0
+        for ci, c in enumerate(cols):
+            payload[f"d{ci}"] = c.data
+            payload[f"v{ci}"] = c.valid_mask()
+            payload[f"k{nbits}"] = _order.sort_keys([c])[0]
+            nbits += 1
+            if has_validity[ci]:
+                # validity participates in the row key (nulls compare equal,
+                # matching the reference's set-distinct semantics)
+                payload[f"k{nbits}"] = c.valid_mask().astype(jnp.uint8)
+                nbits += 1
+        payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
+        out, emit, _cap = exchange(payload, targets, shard.pin(t_emit, ctx),
+                                   ctx)
+        kbits = tuple(out[f"k{j}"] for j in range(nbits))
+        dat, val = _payload_tuples(out, len(cols))
+        shuffled.append((kbits, emit, dat, val))
+
+    (lkb, lemit, ldat, lval), (rkb, remit, rdat, rval) = shuffled
+
+    counts = np.asarray(jax.device_get(_setop_count_fn(ctx.mesh)(
+        lkb, lemit, rkb, remit))).reshape(world, 3)
+    total = counts[:, int(op)]
+    cap = _pow2(int(total.max()))
+
+    od, ov, emit = _setop_mat_fn(ctx.mesh, op, cap)(
+        lkb, lemit, rkb, remit, ldat, lval, rdat, rval)
+
+    cols = []
+    for d, v, a in zip(od, ov, lcols):
+        cols.append(Column(d, a.dtype, v, a.dictionary, a.name))
+    return Table(cols, ctx, emit)
+
+
+# ---------------------------------------------------------------------------
+# distributed groupby (reference: GroupBy, groupby/groupby.cpp:96-139;
+# the reference pre-aggregates then re-applies the same op — which makes
+# distributed COUNT wrong (SURVEY §3.2). Here the shuffle co-locates all
+# rows of a key first, so ONE aggregation pass is both correct and simple;
+# pre-aggregation is a future bandwidth optimization.)
+# ---------------------------------------------------------------------------
+
+def distributed_groupby(table: Table, index_col, aggregate_cols: List,
+                        aggregate_ops: List[_groupby.AggregationOp]) -> Table:
+    ctx = table._ctx
+    world = ctx.get_world_size()
+    if world == 1:
+        return table_mod.groupby_local(table, index_col, aggregate_cols,
+                                       aggregate_ops)
+
+    t = shard.distribute(table, ctx)
+    idx_cols = index_col if isinstance(index_col, (list, tuple)) else [index_col]
+    idx_cols = [t._col_index(c) for c in idx_cols]
+    val_cols = [t._col_index(c) for c in aggregate_cols]
+    key_columns = [t._columns[i] for i in idx_cols]
+
+    targets = shard.pin(_hash.partition_targets(key_columns, world), ctx)
+    payload = {}
+    for j, c in enumerate(key_columns):
+        payload[f"kb{j}"] = _order.sort_keys([c])[0]
+        payload[f"kd{j}"] = c.data
+        payload[f"kv{j}"] = c.valid_mask()
+    for j, vi in enumerate(val_cols):
+        payload[f"d{j}"] = t._columns[vi].data
+        payload[f"v{j}"] = t._columns[vi].valid_mask()
+    payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
+    out, emit, _cap = exchange(payload, targets, shard.pin(t.emit_mask(), ctx),
+                               ctx)
+
+    nk, nv = len(idx_cols), len(val_cols)
+    kbits = tuple(out[f"kb{j}"] for j in range(nk))
+    kdat = tuple(out[f"kd{j}"] for j in range(nk))
+    kval = tuple(out[f"kv{j}"] for j in range(nk))
+    vdat = tuple(out[f"d{j}"] for j in range(nv))
+    vval = tuple(out[f"v{j}"] for j in range(nv))
+
+    ops = tuple(aggregate_ops)
+    kout, kvout, gvalid, agg = _groupby_fn(ctx.mesh, ops)(
+        kbits, kdat, kval, emit, vdat, vval)
+
+    cols = []
+    for d, v, src_i in zip(kout, kvout, idx_cols):
+        src = t._columns[src_i]
+        cols.append(Column(d, src.dtype, v, src.dictionary, src.name))
+    for (arr, av), vi, op in zip(agg, val_cols, aggregate_ops):
+        src = t._columns[vi]
+        keep_dict = (op in (_groupby.AggregationOp.MIN,
+                            _groupby.AggregationOp.MAX) and src.is_string)
+        cols.append(Column(arr, table_mod._agg_dtype(src, op), av,
+                           src.dictionary if keep_dict else None, src.name))
+    return Table(cols, ctx, gvalid)
+
+
+# ---------------------------------------------------------------------------
+# distributed sort (reference has local Sort only, table.hpp:365; here a
+# GLOBAL sort over the sharded arrays — XLA lowers the cross-shard sort/
+# gather itself. Stays on device: dead rows sort to the tail via an emit
+# key instead of host-side compaction.)
+# ---------------------------------------------------------------------------
+
+def distributed_sort(table: Table, order_by, ascending=True) -> Table:
+    ctx = table._ctx
+    t = shard.distribute(table, ctx) if ctx.is_distributed() else table
+    by = order_by if isinstance(order_by, (list, tuple)) else [order_by]
+    idxs = [t._col_index(c) for c in by]
+    asc = list(ascending) if isinstance(ascending, (list, tuple)) \
+        else [ascending] * len(idxs)
+    keys = _order.sort_keys([t._columns[i] for i in idxs], asc)
+    emit = t.emit_mask()
+    dead_last = (~emit).astype(jnp.uint8)  # live rows first, padding at tail
+    perm = _order.lexsort_indices([dead_last] + keys)
+    cols = []
+    for c in t._columns:
+        g = c.take(perm)
+        validity = None if g.validity is None else shard.pin(g.validity, ctx)
+        cols.append(Column(shard.pin(g.data, ctx), g.dtype, validity,
+                           g.dictionary, g.name))
+    return Table(cols, ctx, shard.pin(jnp.take(emit, perm), ctx))

@@ -1,0 +1,112 @@
+"""Row-sharding of tables over the device mesh.
+
+The reference's distribution model is "one ragged Arrow table per MPI rank"
+(reference: cpp/src/cylon/ctx/cylon_context.hpp:29 — rank/world_size; every
+distributed op is a collective all ranks enter). The TPU-native model keeps
+ONE global Table whose column arrays carry a `jax.sharding.NamedSharding`
+over the 1-D mesh axis: shard i of every array is partition i. Raggedness
+is expressed by padding every shard to one common capacity and masking the
+padding rows via the table's ``row_mask`` — XLA requires static, equal
+shapes per shard; the mask is the moral equivalent of Arrow's per-rank row
+counts.
+
+`distribute` is the entry point: pad → device_put with the row sharding.
+It is a no-op for tables already laid out on the context's mesh, so eager
+op pipelines don't re-transfer.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..context import CylonContext
+from ..data.column import Column
+from ..data.table import Table
+
+# Per-shard capacities are rounded to a multiple of 8 (TPU sublane quantum)
+_ROW_QUANTUM = 8
+
+
+def row_sharding(ctx: CylonContext) -> NamedSharding:
+    """The canonical row-partitioned sharding for this context's mesh."""
+    return NamedSharding(ctx.mesh, P(ctx.axis))
+
+
+def is_row_sharded(arr, ctx: CylonContext) -> bool:
+    sh = getattr(arr, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return False
+    return sh.mesh == ctx.mesh and sh.spec == P(ctx.axis)
+
+
+def is_distributed_table(table: Table, ctx: CylonContext) -> bool:
+    if not table._columns:
+        return False
+    n = table.capacity
+    if n % ctx.get_world_size() != 0:
+        return False
+    return all(is_row_sharded(c.data, ctx) for c in table._columns)
+
+
+def pin(arr, ctx: CylonContext):
+    """Force an array onto the row sharding (no-op when already there).
+
+    Eager elementwise ops usually preserve sharding, but host-built or
+    gather-produced arrays may not carry it — pin before entering a
+    shard_map kernel."""
+    if is_row_sharded(arr, ctx):
+        return arr
+    return jax.device_put(arr, row_sharding(ctx))
+
+
+def shard_capacity(n: int, world: int) -> int:
+    """Per-shard padded capacity for n global rows."""
+    c = -(-max(n, 1) // world)
+    return -(-c // _ROW_QUANTUM) * _ROW_QUANTUM
+
+
+def _pad_to(arr: jnp.ndarray, total: int, fill):
+    n = arr.shape[0]
+    if n == total:
+        return arr
+    pad = jnp.full((total - n,) + arr.shape[1:], fill, arr.dtype)
+    return jnp.concatenate([arr, pad])
+
+
+def distribute(table: Table, ctx: CylonContext) -> Table:
+    """Shard a table's rows over the context mesh (pad + device_put).
+
+    Already-distributed tables pass through untouched. The result's
+    ``row_mask`` marks padding rows dead; real rows keep their validity.
+    """
+    if is_distributed_table(table, ctx):
+        return table
+    world = ctx.get_world_size()
+    n = table.capacity
+    cap = shard_capacity(n, world)
+    total = world * cap
+    sharding = row_sharding(ctx)
+
+    cols = []
+    for c in table._columns:
+        data = jax.device_put(_pad_to(c.data, total, 0), sharding)
+        validity = None
+        if c.validity is not None:
+            validity = jax.device_put(_pad_to(c.validity, total, False), sharding)
+        cols.append(Column(data, c.dtype, validity, c.dictionary, c.name))
+    mask = jax.device_put(_pad_to(table.emit_mask(), total, False), sharding)
+    return Table(cols, ctx, mask)
+
+
+def distribute_array(arr, n_src_rows: int, ctx: CylonContext,
+                     fill=0) -> jnp.ndarray:
+    """Shard an auxiliary per-row array with the same padding geometry a
+    table of ``n_src_rows`` rows gets from `distribute`."""
+    world = ctx.get_world_size()
+    cap = shard_capacity(n_src_rows, world)
+    return jax.device_put(_pad_to(jnp.asarray(arr), world * cap, fill),
+                          row_sharding(ctx))

@@ -1,0 +1,121 @@
+"""The shuffle: hash-partition + all-to-all exchange on XLA collectives.
+
+This is the TPU-native replacement for the reference's entire four-layer
+communication stack (reference: cpp/src/cylon/net/mpi/mpi_channel.cpp:30-247
+two-phase header+body MPI protocol with per-peer FSMs; net/ops/
+all_to_all.cpp:26-178 queue/FIN machinery; arrow/arrow_all_to_all.cpp:24-264
+per-buffer Arrow serialization). None of that machinery is translated:
+inside one compiled SPMD program, `jax.lax.all_to_all` over the mesh axis IS
+the transport, XLA program order replaces MPI tags/edges, and program
+completion replaces the FIN handshake.
+
+The reference's variable-length problem (its 8-int length header preceding
+every body message) maps to the static-shape world as a TWO-PHASE exchange:
+
+  phase 1 ("header"): a tiny compiled program computes the per-(src,dst)
+     send-count matrix — one [W] vector per shard, gathered to the host;
+  phase 2 ("body"):   the host picks a pow2 block size B = max count (this
+     bounds recompilation to O(log) distinct programs), and a second
+     compiled program bucket-sorts rows by target shard, scatters them into
+     a [W, B] send buffer per column, and runs ONE `all_to_all` per column
+     over ICI. Padding slots carry emit=False.
+
+Rows whose emit mask is False (table padding, filtered rows) are dropped in
+transit — the shuffle doubles as a compaction step.
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+try:  # jax>=0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..context import CylonContext
+from ..util import pow2 as _pow2
+from .shard import row_sharding
+
+
+@lru_cache(maxsize=None)
+def _count_fn(mesh):
+    """Per-shard send-count vector: counts[t] = live rows headed to shard t.
+
+    The moral equivalent of the reference's header phase
+    (mpi_channel.cpp:211-225 sendHeader)."""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+
+    def kernel(targets, emit):
+        t = jnp.where(emit, targets.astype(jnp.int32), world)
+        counts = jax.ops.segment_sum(jnp.ones(t.shape[0], jnp.int32), t,
+                                     num_segments=world + 1)
+        return counts[:world]
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec),
+                             out_specs=spec))
+
+
+@lru_cache(maxsize=None)
+def _exchange_fn(mesh, block: int):
+    """The body phase: bucket-sort by target, scatter to [W, B] blocks,
+    one `all_to_all` per payload leaf, flatten back to [W*B] rows."""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+
+    def kernel(payload, targets, emit):
+        n = targets.shape[0]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        t = jnp.where(emit, targets.astype(jnp.int32), world)
+        # stable bucket sort by target: one fused device sort yields the
+        # permutation every column reuses (the reference's per-dtype split
+        # kernels, arrow_kernels.cpp:24-134, collapse into this one sort)
+        t_sorted, perm = jax.lax.sort((t, iota), num_keys=1)
+        counts = jax.ops.segment_sum(jnp.ones(n, jnp.int32), t,
+                                     num_segments=world + 1)[:world]
+        start = jnp.cumsum(counts) - counts
+        pos = iota - jnp.take(start, jnp.minimum(t_sorted, world - 1))
+        flat = jnp.where(t_sorted < world, t_sorted * block + pos,
+                         world * block)  # out-of-range -> dropped
+
+        def exchange_leaf(x):
+            xs = jnp.take(x, perm, axis=0)
+            buf = jnp.zeros((world * block,) + x.shape[1:], x.dtype)
+            buf = buf.at[flat].set(xs, mode="drop")
+            buf = buf.reshape((world, block) + x.shape[1:])
+            out = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                                     tiled=False)
+            return out.reshape((world * block,) + x.shape[1:])
+
+        return jax.tree.map(exchange_leaf, payload)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec))
+
+
+def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
+             emit: jnp.ndarray, ctx: CylonContext
+             ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, int]:
+    """Shuffle a pytree of row-sharded per-row arrays to their target shards.
+
+    Returns (exchanged payload, new emit mask, per-shard capacity). All
+    outputs are row-sharded; capacity = W * B where B is the pow2 block.
+    """
+    world = ctx.get_world_size()
+    if "__emit__" in payload:
+        raise ValueError("__emit__ is a reserved payload key")
+    counts = np.asarray(jax.device_get(_count_fn(ctx.mesh)(targets, emit)))
+    block = _pow2(int(counts.max()) if counts.size else 1)
+    full = dict(payload)
+    full["__emit__"] = emit
+    out = _exchange_fn(ctx.mesh, block)(full, targets, emit)
+    new_emit = out.pop("__emit__")
+    return out, new_emit, world * block

@@ -1,0 +1,9 @@
+"""Small shared helpers."""
+from __future__ import annotations
+
+
+def pow2(n: int) -> int:
+    """Round up to a power of two (≥1). All data-dependent capacities are
+    pow2-rounded so the count→materialize discipline compiles O(log n)
+    distinct programs instead of one per size."""
+    return 1 if n <= 1 else 1 << (int(n) - 1).bit_length()

@@ -1,0 +1,245 @@
+"""Distributed-op tests on the virtual device mesh.
+
+Replaces the reference's mpirun-based distributed tests (reference:
+python/test/test_dist_rl.py run under `mpirun -n 4` — test_all.py:100-143;
+cpp/test/ golden tests at world sizes {1,2,4}): the mesh is W virtual CPU
+devices in ONE process, inputs are the same per-rank CSV fixtures
+concatenated into one global sharded table, and expectations are
+(a) the reference's golden outputs (multiset over all ranks) and
+(b) equivalence with our own local kernels on random data.
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.parallel import dist_ops, distribute, is_distributed_table
+from conftest import REFERENCE_DATA, assert_rows_equal
+
+INP = os.path.join(REFERENCE_DATA, "input")
+OUT = os.path.join(REFERENCE_DATA, "output")
+
+
+def read_all_ranks(ctx, base, world):
+    """One global table = concat of the reference's per-rank inputs."""
+    parts = [ct.read_csv(ctx, os.path.join(INP, f"{base}_{r}.csv"))
+             for r in range(world)]
+    return parts[0].merge(parts[1:]) if len(parts) > 1 else parts[0]
+
+
+def golden_all_ranks(op, world):
+    dfs = [pd.read_csv(os.path.join(OUT, f"{op}_{world}_{r}.csv"))
+           for r in range(world)]
+    return pd.concat(dfs, ignore_index=True)
+
+
+def _sorted(df):
+    df = df.copy()
+    df.columns = range(df.shape[1])
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+def cmp_tables(dist_t, local_t, name):
+    d, l = _sorted(dist_t.to_pandas()), _sorted(local_t.to_pandas())
+    assert d.shape == l.shape, f"{name}: {d.shape} != {l.shape}"
+    pd.testing.assert_frame_equal(d, l, check_dtype=False, atol=1e-6,
+                                  obj=name)
+
+
+# ---------------------------------------------------------------------------
+# golden fixtures (world=4, matching the reference's mpirun -np 4 cases)
+# ---------------------------------------------------------------------------
+
+def test_golden_distributed_join_inner(dist_ctx):
+    t1 = read_all_ranks(dist_ctx, "csv1", 4)
+    t2 = read_all_ranks(dist_ctx, "csv2", 4)
+    got = t1.distributed_join(t2, "inner", "sort", on=[0]).to_pandas()
+    assert_rows_equal(got, golden_all_ranks("join_inner", 4),
+                      msg="join_inner world=4")
+
+
+@pytest.mark.parametrize("op", ["union", "subtract", "intersect"])
+def test_golden_distributed_setops(dist_ctx, op):
+    t1 = read_all_ranks(dist_ctx, "csv1", 4)
+    t2 = read_all_ranks(dist_ctx, "csv2", 4)
+    got = getattr(t1, f"distributed_{op}")(t2).to_pandas()
+    assert_rows_equal(got, golden_all_ranks(op, 4), msg=f"{op} world=4")
+
+
+@pytest.mark.parametrize("world", [2])
+def test_golden_distributed_join_world2(world):
+    ctx = ct.CylonContext.InitDistributed(ct.TPUConfig(world_size=world))
+    t1 = read_all_ranks(ctx, "csv1", world)
+    t2 = read_all_ranks(ctx, "csv2", world)
+    got = t1.distributed_join(t2, "inner", "sort", on=[0]).to_pandas()
+    assert_rows_equal(got, golden_all_ranks("join_inner", world),
+                      msg=f"join_inner world={world}")
+
+
+# ---------------------------------------------------------------------------
+# shuffle invariants
+# ---------------------------------------------------------------------------
+
+def test_shuffle_preserves_rows(dist_ctx):
+    rng = np.random.default_rng(7)
+    n = 1234
+    t = ct.Table.from_pydict(dist_ctx, {"a": rng.integers(0, 97, n),
+                                        "b": rng.normal(size=n)})
+    s = dist_ops.shuffle(t, ["a"])
+    assert s.row_count == n
+    assert is_distributed_table(s, dist_ctx)
+    cmp_tables(s, t, "shuffle multiset")
+
+
+def test_shuffle_colocates_keys(dist_ctx):
+    """After a hash shuffle every key lives in exactly one shard."""
+    import jax
+
+    rng = np.random.default_rng(8)
+    n = 512
+    t = ct.Table.from_pydict(dist_ctx, {"a": rng.integers(0, 37, n)})
+    s = dist_ops.shuffle(t, ["a"])
+    world = dist_ctx.get_world_size()
+    cap = s.capacity // world
+    data = np.asarray(jax.device_get(s.get_column(0).data))
+    mask = np.asarray(jax.device_get(s.emit_mask()))
+    owner = {}
+    for shard_i in range(world):
+        sl = slice(shard_i * cap, (shard_i + 1) * cap)
+        for v in np.unique(data[sl][mask[sl]]):
+            assert owner.setdefault(int(v), shard_i) == shard_i, \
+                f"key {v} in shards {owner[int(v)]} and {shard_i}"
+
+
+def test_distribute_roundtrip(dist_ctx):
+    df = pd.DataFrame({"x": np.arange(100), "s": [f"v{i%7}" for i in range(100)]})
+    t = distribute(ct.Table.from_pandas(dist_ctx, df), dist_ctx)
+    assert t.row_count == 100
+    pd.testing.assert_frame_equal(t.to_pandas(), df)
+
+
+def test_repartition_balances(dist_ctx):
+    t = ct.Table.from_pydict(dist_ctx, {"a": np.arange(100)})
+    r = dist_ops.repartition(t, dist_ctx)
+    assert r.row_count == 100
+    cmp_tables(r, t, "repartition multiset")
+
+
+def test_hash_partition(local_ctx):
+    t = ct.Table.from_pydict(local_ctx, {"a": np.arange(50) % 13,
+                                         "b": np.arange(50)})
+    parts = dist_ops.hash_partition(t, ["a"], 4)
+    assert sorted(parts.keys()) == [0, 1, 2, 3]
+    assert sum(p.row_count for p in parts.values()) == 50
+    # each key lands in exactly one partition
+    seen = {}
+    for pid, p in parts.items():
+        for v in np.unique(p.to_pydict()["a"]):
+            assert seen.setdefault(int(v), pid) == pid
+
+
+# ---------------------------------------------------------------------------
+# dist op == local op on random data (all join types, nulls, strings, skew)
+# ---------------------------------------------------------------------------
+
+def _pair(rng, n, nkeys, ctx, skew=False, nulls=False, strings=False):
+    if skew:
+        keys = np.where(rng.random(n) < 0.5, 0, rng.integers(0, nkeys, n))
+    else:
+        keys = rng.integers(0, nkeys, n)
+    d = {"k": keys, "v": rng.normal(size=n)}
+    if strings:
+        vocab = np.array([f"name-{i}" for i in range(nkeys)])
+        d["k"] = vocab[keys]
+    if nulls:
+        v = d["v"].copy()
+        v[rng.random(n) < 0.1] = np.nan
+        d["v"] = v
+    return ct.Table.from_pydict(ctx, d)
+
+
+@pytest.mark.parametrize("jt", ["inner", "left", "right", "outer"])
+@pytest.mark.parametrize("flags", [{}, {"skew": True},
+                                   {"nulls": True, "strings": True}])
+def test_dist_join_matches_local(dist_ctx, local_ctx, jt, flags):
+    rng = np.random.default_rng(42)
+    dl = _pair(rng, 700, 60, dist_ctx, **flags)
+    rng2 = np.random.default_rng(43)
+    dr = _pair(rng2, 500, 60, dist_ctx, **flags)
+    ll = ct.Table.from_pydict(local_ctx, dl.to_pydict())
+    lr = ct.Table.from_pydict(local_ctx, dr.to_pydict())
+    cmp_tables(dl.distributed_join(dr, jt, on="k"),
+               ll.join(lr, jt, on="k"), f"join {jt} {flags}")
+
+
+@pytest.mark.parametrize("op", ["union", "subtract", "intersect"])
+def test_dist_setops_match_local(dist_ctx8, local_ctx, op):
+    rng = np.random.default_rng(5)
+    a = {"x": rng.integers(0, 40, 800), "y": rng.integers(0, 3, 800)}
+    b = {"x": rng.integers(0, 40, 500), "y": rng.integers(0, 3, 500)}
+    dl = ct.Table.from_pydict(dist_ctx8, a)
+    dr = ct.Table.from_pydict(dist_ctx8, b)
+    ll = ct.Table.from_pydict(local_ctx, a)
+    lr = ct.Table.from_pydict(local_ctx, b)
+    cmp_tables(getattr(dl, f"distributed_{op}")(dr),
+               getattr(ll, op)(lr), f"setop {op}")
+
+
+@pytest.mark.parametrize("ops", [["sum", "count", "min", "max"],
+                                 ["mean", "count"]])
+def test_dist_groupby_matches_local(dist_ctx, local_ctx, ops):
+    """Includes the distributed-COUNT correctness case the reference gets
+    wrong (SURVEY §3.2): keys span shards pre-shuffle."""
+    rng = np.random.default_rng(6)
+    n = 900
+    d = {"k": rng.integers(0, 25, n), "v": rng.normal(size=n)}
+    dt = ct.Table.from_pydict(dist_ctx, d)
+    lt = ct.Table.from_pydict(local_ctx, d)
+    cmp_tables(dt.groupby(0, ["v"] * len(ops), ops),
+               lt.groupby(0, ["v"] * len(ops), ops), f"groupby {ops}")
+
+
+def test_dist_groupby_string_keys(dist_ctx, local_ctx):
+    rng = np.random.default_rng(9)
+    n = 400
+    vocab = np.array(["ny", "sf", "la", "dc", "chi"])
+    d = {"city": vocab[rng.integers(0, 5, n)], "pop": rng.integers(0, 1000, n)}
+    dt = ct.Table.from_pydict(dist_ctx, d)
+    lt = ct.Table.from_pydict(local_ctx, d)
+    cmp_tables(dt.groupby(0, ["pop", "pop"], ["sum", "max"]),
+               lt.groupby(0, ["pop", "pop"], ["sum", "max"]), "groupby str")
+
+
+def test_dist_scalar_aggregates(dist_ctx):
+    rng = np.random.default_rng(10)
+    v = rng.normal(size=1000)
+    t = distribute(ct.Table.from_pydict(dist_ctx, {"v": v}), dist_ctx)
+    assert abs(float(t.sum("v").to_pydict()["v"][0]) - v.sum()) < 1e-6
+    assert int(t.count("v").to_pydict()["v"][0]) == 1000
+    assert abs(float(t.min("v").to_pydict()["v"][0]) - v.min()) < 1e-12
+    assert abs(float(t.max("v").to_pydict()["v"][0]) - v.max()) < 1e-12
+
+
+def test_dist_join_result_feeds_next_op(dist_ctx):
+    """Outputs of dist ops are themselves sharded tables usable downstream
+    (op pipelining without host round-trips)."""
+    rng = np.random.default_rng(11)
+    n = 300
+    a = ct.Table.from_pydict(dist_ctx, {"k": rng.integers(0, 20, n),
+                                        "v": rng.normal(size=n)})
+    b = ct.Table.from_pydict(dist_ctx, {"k": rng.integers(0, 20, n),
+                                        "w": rng.integers(0, 5, n)})
+    j = a.distributed_join(b, "inner", on="k")
+    g = j.groupby(0, [1], ["sum"])
+    assert g.row_count <= 20
+    assert g.row_count > 0
+
+
+def test_world1_distributed_falls_back_to_local():
+    ctx = ct.CylonContext.InitDistributed(ct.TPUConfig(world_size=1))
+    a = ct.Table.from_pydict(ctx, {"k": [1, 2, 2], "v": [1., 2., 3.]})
+    b = ct.Table.from_pydict(ctx, {"k": [2, 3], "u": [10, 20]})
+    j = a.distributed_join(b, "inner", on="k")
+    assert j.row_count == 2

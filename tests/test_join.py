@@ -112,3 +112,35 @@ def test_join_config_factories():
     assert cfg.GetRightColumnIdx() == [1]
     cfg2 = ct.JoinConfig.FullOuterJoin(0, 0, ct.JoinAlgorithm.HASH)
     assert cfg2.GetAlgorithm() == ct.JoinAlgorithm.HASH
+
+
+def test_right_join_padded_table_with_null_keys(local_ctx):
+    """Regression: emit-mask sentinels must not collide with null-key
+    sentinels when _expand_pairs runs with swapped sides (RIGHT join).
+    A padded right table + null left keys produced phantom matches."""
+    import jax.numpy as jnp
+
+    l = pd.DataFrame({"k": [5.0, np.nan], "v": [1.0, 2.0]})
+    tl = ct.Table.from_pandas(local_ctx, l)
+    # right table padded the way join/dist outputs are: one dead slot
+    tr = ct.Table.from_pydict(local_ctx, {"k": [99.0, 5.0, 7.0],
+                                          "w": [0.0, 10.0, 20.0]})
+    tr.row_mask = jnp.asarray([False, True, True])
+    got = tl.join(tr, "right", "sort", on=["k"]).to_pandas()
+    got = got.sort_values("rt-2").reset_index(drop=True)
+    # expected: (5,5) matched + unmatched right row 7; dead 99 row absent
+    assert got.shape[0] == 2
+    assert list(got["rt-2"]) == [5.0, 7.0]
+    assert got["lt-0"].iloc[1] is None or np.isnan(got["lt-0"].iloc[1])
+
+
+def test_filter_on_padded_join_result(local_ctx):
+    """Regression: t[t['c'] > x] must work on join results (which keep
+    pow2 padding + row_mask)."""
+    t1 = ct.Table.from_pydict(local_ctx, {"a": [1, 2, 3], "v": [1, 2, 3]})
+    t2 = ct.Table.from_pydict(local_ctx, {"a": [1, 2, 3], "w": [4, 5, 6]})
+    j = t1.join(t2, "inner", "sort", on=["a"])
+    assert j.capacity >= j.row_count  # padded
+    f = j[j["rt-3"] > 4]
+    assert f.row_count == 2
+    assert sorted(f.to_pydict()["rt-3"].tolist()) == [5, 6]
