@@ -608,17 +608,20 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     rkvalid = tuple(c.validity for c in rcols)
     lemit, remit = left.row_mask, right.row_mask
 
-    counts = _join.unpack_counts(jax.device_get(_join.count_program(
-        lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags)))
-    cap_l, cap_u = _join.caps_for(config.type, counts)
+    counts2, lo, m, bperm, un_mask = _join.plan_program(
+        lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags, config.type)
+    n_primary, n_un = (int(v) for v in jax.device_get(counts2))
+    cap_p = _pow2(n_primary)
+    cap_u = _pow2(n_un) if config.type == _join.JoinType.FULL_OUTER else 0
+    aemit = remit if config.type == _join.JoinType.RIGHT else lemit
 
     ldat = tuple(c.data for c in left._columns)
     lval = tuple(c.validity for c in left._columns)
     rdat = tuple(c.data for c in right._columns)
     rval = tuple(c.validity for c in right._columns)
     lod, lov, rod, rov, emit = _join.materialize_program(
-        lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
-        ldat, lval, rdat, rval, str_flags, config.type, cap_l, cap_u)
+        lo, m, bperm, un_mask, aemit,
+        ldat, lval, rdat, rval, config.type, cap_p, cap_u)
 
     nl = left.column_count
     cols = [Column(d, c.dtype, v, c.dictionary, f"lt-{i}")

@@ -114,8 +114,8 @@ def _as_list(v):
 
 LEFT_NULL_GID = np.int32(-1)
 RIGHT_NULL_GID = np.int32(-2)
-# Emit-mask sentinels are DISTINCT from the null sentinels: kernels like
-# _expand_pairs are called with sides swapped for RIGHT joins, so a masked
+# Emit-mask sentinels are DISTINCT from the null sentinels: the plan runs
+# with sides swapped for RIGHT joins, so a masked
 # first-arg row re-tagged with LEFT_NULL_GID would collide with a null-key
 # row of the true left table (already −1 from compute_gids). −3/−4 can
 # never equal a real gid (≥0) or a null sentinel on either side.
@@ -185,62 +185,77 @@ def _masked_indices(mask, out_size: int) -> jnp.ndarray:
     return jnp.where(j < cnt, idx, -1).astype(jnp.int32)
 
 
-@jax.jit
-def join_counts(gl, gr, lemit, remit):
-    """One pass computing every count any join type needs.
-
-    Returns dict of int32 scalars: n_inner, n_left, n_right, n_full.
-    """
-    gl, gr = _mask_gids(gl, gr, lemit, remit)
-    _, m = _match_lo_m(gl, gr)
-    _, mr = _match_lo_m(gr, gl)
-    n_inner = m.sum()
-    n_left = jnp.where(lemit, jnp.maximum(m, 1), 0).sum()
-    n_right = jnp.where(remit, jnp.maximum(mr, 1), 0).sum()
-    r_unmatched = (remit & (mr == 0)).sum()
-    return {
-        "n_inner": n_inner,
-        "n_left": n_left,
-        "n_right": n_right,
-        "n_full": n_left + r_unmatched,
-    }
+# ---------------------------------------------------------------------------
+# plan / materialize. A join is TWO device programs separated by one
+# 2-scalar host sync (the static-shape capacity decision):
+#
+#   plan:        gids → match info (lo, m), gid-sorted b permutation,
+#                unmatched-b mask, output COUNTS. One match sort (+ one
+#                more for FULL_OUTER's unmatched side).
+#   materialize: consumes the plan's DEVICE arrays — duplicate-run
+#                expansion + payload gathers. No re-sorting: the expensive
+#                match sort is computed once and reused across the phases.
+#
+# "A/B space": A is the probe side (left, or right for RIGHT joins so the
+# same expansion kernel serves all types), B the build side.
+# ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("out_size", "emit_unmatched_left"))
-def _expand_pairs(gl, gr, lemit, remit, out_size: int,
-                  emit_unmatched_left: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Emit (left_idx, right_idx) pairs for INNER (emit_unmatched_left=False)
-    or LEFT join (True), padded to ``out_size`` with (-1, -1).
+def join_plan_gids(gl, gr, lemit, remit, join_type: JoinType):
+    """Traceable plan. Returns (counts2, lo, m, bperm, un_mask):
+    counts2 = [n_primary, n_unmatched_b] (int32), the rest are the device
+    arrays `join_materialize_gids` consumes."""
+    if join_type == JoinType.RIGHT:
+        ga, gb, aemit, bemit = gr, gl, remit, lemit
+    else:
+        ga, gb, aemit, bemit = gl, gr, lemit, remit
+    gam, gbm = _mask_gids(ga, gb, aemit, bemit)
+    nb = gbm.shape[0]
+    lo, m = _match_lo_m(gam, gbm)
+    biota = jnp.arange(nb, dtype=jnp.int32)
+    _, bperm = jax.lax.sort((gbm, biota), num_keys=1)
+    # gid-sorted b order puts sentinel rows FIRST; `lo` counts them too
+    # (#b with smaller gid), so run positions stay consistent.
+    if join_type == JoinType.INNER:
+        n_primary = m.sum()
+    else:
+        n_primary = jnp.where(aemit, jnp.maximum(m, 1), 0).sum()
+    if join_type == JoinType.FULL_OUTER:
+        _, mb = _match_lo_m(gbm, gam)
+        un_mask = bemit & (mb == 0)
+        n_un = un_mask.sum()
+    else:
+        un_mask = jnp.zeros(nb, bool)
+        n_un = jnp.int32(0)
+    counts2 = jnp.stack([n_primary, n_un]).astype(jnp.int32)
+    return counts2, lo, m, bperm, un_mask
 
-    Right rows of gid g occupy a contiguous run [start_r[g], start_r[g]+
-    cnt_r[g]) of the gid-sorted right permutation; left row i's j-th output
-    picks run slot k = j - first_output_of_i. The j→i map is materialized by
-    scattering each emitting row's index at its first output slot and
-    taking a cumulative max (duplicate-run expansion with no search)."""
-    gl, gr = _mask_gids(gl, gr, lemit, remit)
-    nl, nr = gl.shape[0], gr.shape[0]
-    if nl == 0:
+
+def _expand_from_match(lo, m, aemit, bperm, out_size: int,
+                       emit_unmatched_a: bool
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Emit (a_idx, b_idx) pairs from precomputed match info, padded to
+    ``out_size`` with (-1, -1).
+
+    B rows of a gid occupy a contiguous run of the gid-sorted b permutation
+    starting at lo; a row i's j-th output picks run slot j − first_output_i.
+    The j→i map: scatter a 1 at each emitting run's start (unique slots),
+    cumsum ranks positions into ordinal runs, and a gather through the
+    compacted emitting-row list recovers i — no cumulative max (215 s
+    COMPILE at 2M) and no binary search."""
+    na, nb = lo.shape[0], bperm.shape[0]
+    if na == 0:
         e = jnp.full(out_size, -1, jnp.int32)
         return e, e
-    lo, m = _match_lo_m(gl, gr)
-    riota = jnp.arange(nr, dtype=jnp.int32)
-    _, rperm = jax.lax.sort((gr, riota), num_keys=1)
-    # gr-sorted order puts sentinel (-2) rows FIRST; `lo` counts them too
-    # (#b with smaller gid), so run positions stay consistent
-    mm = jnp.where(lemit & emit_unmatched_left, jnp.maximum(m, 1), m)
+    mm = jnp.where(aemit & emit_unmatched_a, jnp.maximum(m, 1), m)
     off = jnp.cumsum(mm)
     total = off[-1]
     starts = off - mm
 
-    liota = jnp.arange(nl, dtype=jnp.int32)
-    # j → emitting-row map without a cumulative max (associative_scan(max)
-    # compiles catastrophically slowly on TPU): scatter a 1 at each run
-    # start (unique slots), cumsum ranks each output position into its
-    # ordinal emitting run, and a gather through the compacted emitting-row
-    # list recovers the row index.
+    aiota = jnp.arange(na, dtype=jnp.int32)
     erank = jnp.cumsum((mm > 0).astype(jnp.int32))  # inclusive
-    emit_list = jnp.zeros(nl, jnp.int32).at[
-        jnp.where(mm > 0, erank - 1, nl)].set(liota, mode="drop")
+    emit_list = jnp.zeros(na, jnp.int32).at[
+        jnp.where(mm > 0, erank - 1, na)].set(aiota, mode="drop")
     z = jnp.zeros(out_size, jnp.int32)
     z = z.at[jnp.where(mm > 0, starts, out_size)].set(1, mode="drop")
     c = jnp.cumsum(z)  # 1-based ordinal of the run covering position j
@@ -248,68 +263,34 @@ def _expand_pairs(gl, gr, lemit, remit, out_size: int,
 
     j = jnp.arange(out_size, dtype=jnp.int32)
     k = j - jnp.take(starts, i)
-    rpos = jnp.take(lo, i) + k
-    if nr == 0:
-        ridx = jnp.full(out_size, -1, jnp.int32)
+    bpos = jnp.take(lo, i) + k
+    if nb == 0:
+        bidx = jnp.full(out_size, -1, jnp.int32)
     else:
-        ridx = jnp.take(rperm, rpos, mode="fill", fill_value=0)
-        ridx = jnp.where(jnp.take(m, i) > 0, ridx, -1)
+        bidx = jnp.take(bperm, bpos, mode="fill", fill_value=0)
+        bidx = jnp.where(jnp.take(m, i) > 0, bidx, -1)
     valid = j < total
-    lidx = jnp.where(valid, i, -1)
-    ridx = jnp.where(valid, ridx, -1)
-    return lidx, ridx
+    aidx = jnp.where(valid, i, -1)
+    bidx = jnp.where(valid, bidx, -1)
+    return aidx, bidx
 
 
-@partial(jax.jit, static_argnames=("out_size",))
-def _unmatched_right(gl, gr, lemit, remit, out_size: int) -> jnp.ndarray:
-    """Right rows with no left match, padded to out_size with -1."""
-    if gr.shape[0] == 0:
-        return jnp.full(out_size, -1, jnp.int32)
-    gl, gr = _mask_gids(gl, gr, lemit, remit)
-    _, mr = _match_lo_m(gr, gl)
-    un = remit & (mr == 0)
-    return _masked_indices(un, out_size)
-
-
-def caps_for(join_type: JoinType, counts: dict) -> Tuple[int, int]:
-    """Static (primary, unmatched-right) output capacities for a type."""
-    if join_type == JoinType.INNER:
-        return _pow2(counts["n_inner"]), 0
-    if join_type == JoinType.LEFT:
-        return _pow2(counts["n_left"]), 0
+def join_materialize_gids(lo, m, bperm, un_mask, aemit,
+                          join_type: JoinType, cap_p: int, cap_u: int
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Traceable (lidx, ridx, emit) at static capacity from a plan's
+    arrays. emit marks live output rows; padding carries (-1, -1, False)."""
+    aidx, bidx = _expand_from_match(lo, m, aemit, bperm, cap_p,
+                                    join_type != JoinType.INNER)
+    if join_type == JoinType.FULL_OUTER:
+        un = _masked_indices(un_mask, cap_u)
+        aidx = jnp.concatenate([aidx, jnp.full(cap_u, -1, jnp.int32)])
+        bidx = jnp.concatenate([bidx, un])
     if join_type == JoinType.RIGHT:
-        return _pow2(counts["n_right"]), 0
-    return (_pow2(counts["n_left"]),
-            _pow2(counts["n_full"] - counts["n_left"]))
-
-
-def join_pairs_static(gl, gr, lemit, remit, join_type: JoinType,
-                      cap_l: int, cap_u: int
-                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Traceable (lidx, ridx, emit) at static capacity — shared by the
-    local fused programs and the per-shard distributed kernels. emit marks
-    live output rows; padding slots carry (-1, -1, False)."""
-    if join_type == JoinType.RIGHT:
-        ridx, lidx = _expand_pairs(gr, gl, remit, lemit, cap_l, True)
-    elif join_type == JoinType.INNER:
-        lidx, ridx = _expand_pairs(gl, gr, lemit, remit, cap_l, False)
-    elif join_type == JoinType.LEFT:
-        lidx, ridx = _expand_pairs(gl, gr, lemit, remit, cap_l, True)
-    else:  # FULL_OUTER = LEFT part + unmatched right
-        lidx, ridx = _expand_pairs(gl, gr, lemit, remit, cap_l, True)
-        un = _unmatched_right(gl, gr, lemit, remit, cap_u)
-        lidx = jnp.concatenate([lidx, jnp.full(un.shape, -1, jnp.int32)])
-        ridx = jnp.concatenate([ridx, un])
+        lidx, ridx = bidx, aidx
+    else:
+        lidx, ridx = aidx, bidx
     return lidx, ridx, (lidx >= 0) | (ridx >= 0)
-
-
-# ---------------------------------------------------------------------------
-# Fused whole-join programs. The eager per-op path costs one dispatch per
-# jnp call — ruinous over a tunneled TPU — so the local join is exactly TWO
-# compiled programs: count (→ one 4-scalar host sync) and materialize.
-# ---------------------------------------------------------------------------
-
-_COUNT_KEYS = ("n_inner", "n_left", "n_right", "n_full")
 
 
 def _vm(v, n):
@@ -344,28 +325,26 @@ def _keys_to_gids(lkeys, lkvalid, rkeys, rkvalid, str_flags):
     return compute_gids(lbits, lkv, rbits, rkv)
 
 
-@partial(jax.jit, static_argnames=("str_flags",))
-def count_program(lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags):
-    """Phase 1: everything from raw key columns to the 4 output counts in
-    one compiled program."""
+@partial(jax.jit, static_argnames=("str_flags", "join_type"))
+def plan_program(lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags,
+                 join_type: JoinType):
+    """Phase 1: raw key columns → plan (counts + match arrays), one
+    compiled program. Only counts2 crosses to the host; the match arrays
+    stay on device for phase 2."""
     gl, gr = _keys_to_gids(lkeys, lkvalid, rkeys, rkvalid, str_flags)
-    c = join_counts(gl, gr, _vm(lemit, gl.shape[0]), _vm(remit, gr.shape[0]))
-    return jnp.stack([c[k] for k in _COUNT_KEYS])
+    return join_plan_gids(gl, gr, _vm(lemit, gl.shape[0]),
+                          _vm(remit, gr.shape[0]), join_type)
 
 
-@partial(jax.jit,
-         static_argnames=("str_flags", "join_type", "cap_l", "cap_u"))
-def materialize_program(lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
+@partial(jax.jit, static_argnames=("join_type", "cap_p", "cap_u"))
+def materialize_program(lo, m, bperm, un_mask, aemit,
                         ldat, lval, rdat, rval,
-                        str_flags, join_type: JoinType, cap_l: int,
-                        cap_u: int):
-    """Phase 2: gids → index pairs → gather every payload column, one
-    compiled program. Returns (ldat', lval', rdat', rval', emit)."""
-    gl, gr = _keys_to_gids(lkeys, lkvalid, rkeys, rkvalid, str_flags)
-    lemit = _vm(lemit, gl.shape[0])
-    remit = _vm(remit, gr.shape[0])
-    lidx, ridx, emit = join_pairs_static(gl, gr, lemit, remit, join_type,
-                                         cap_l, cap_u)
+                        join_type: JoinType, cap_p: int, cap_u: int):
+    """Phase 2: plan arrays → index pairs → gather every payload column,
+    one compiled program. Returns (ldat', lval', rdat', rval', emit)."""
+    lidx, ridx, emit = join_materialize_gids(
+        lo, m, bperm, un_mask, _vm(aemit, lo.shape[0]), join_type,
+        cap_p, cap_u)
     lod, lov = gather_columns(ldat, lval, lidx)
     rod, rov = gather_columns(rdat, rval, ridx)
     return lod, lov, rod, rov, emit
@@ -388,7 +367,5 @@ def gather_columns(dat, val, idx):
     return tuple(out_d), tuple(out_v)
 
 
-def unpack_counts(counts_arr) -> dict:
-    return {k: int(v) for k, v in zip(_COUNT_KEYS, counts_arr)}
 
 

@@ -91,14 +91,17 @@ _shard_gids = _join.compute_gids
 
 
 @lru_cache(maxsize=None)
-def _join_count_fn(mesh):
+def _join_plan_fn(mesh, join_type: _join.JoinType):
+    """Per-shard join plan: one match sort per shard, counts + match
+    arrays stay sharded on device for the materialize phase."""
     spec = P(mesh.axis_names[0])
 
     def kernel(lbits, lkv, lemit, rbits, rkv, remit):
         gl, gr = _shard_gids(lbits, lkv, rbits, rkv)
-        c = _join.join_counts(gl, gr, lemit, remit)
-        return jnp.stack([c["n_inner"], c["n_left"], c["n_right"],
-                          c["n_full"]]).astype(jnp.int32)
+        counts2, lo, m, bperm, un_mask = _join.join_plan_gids(
+            gl, gr, lemit, remit, join_type)
+        aemit = remit if join_type == _join.JoinType.RIGHT else lemit
+        return counts2, lo, m, bperm, un_mask, aemit
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
                              out_specs=spec))
@@ -108,13 +111,12 @@ _gather_side = _join.gather_columns
 
 
 @lru_cache(maxsize=None)
-def _join_mat_fn(mesh, join_type: _join.JoinType, cap_l: int, cap_u: int):
+def _join_mat_fn(mesh, join_type: _join.JoinType, cap_p: int, cap_u: int):
     spec = P(mesh.axis_names[0])
 
-    def kernel(lbits, lkv, lemit, rbits, rkv, remit, ldat, lval, rdat, rval):
-        gl, gr = _shard_gids(lbits, lkv, rbits, rkv)
-        lidx, ridx, emit = _join.join_pairs_static(gl, gr, lemit, remit,
-                                                   join_type, cap_l, cap_u)
+    def kernel(lo, m, bperm, un_mask, aemit, ldat, lval, rdat, rval):
+        lidx, ridx, emit = _join.join_materialize_gids(
+            lo, m, bperm, un_mask, aemit, join_type, cap_p, cap_u)
         lod, lov = _gather_side(ldat, lval, lidx)
         rod, rov = _gather_side(rdat, rval, ridx)
         return lod, lov, rod, rov, emit
