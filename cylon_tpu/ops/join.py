@@ -177,6 +177,8 @@ def _masked_indices(mask, out_size: int) -> jnp.ndarray:
     Sort-based (stable sort by ~mask) — jnp.nonzero's lowering is scatter-
     heavy and ignores fill_value on empty operands."""
     n = mask.shape[0]
+    if n == 0:
+        return jnp.full(out_size, -1, jnp.int32)
     iota = jnp.arange(n, dtype=jnp.int32)
     _, srt = jax.lax.sort(((~mask).astype(jnp.int32), iota), num_keys=1)
     cnt = mask.sum()
@@ -203,8 +205,8 @@ def _masked_indices(mask, out_size: int) -> jnp.ndarray:
 
 def join_plan_gids(gl, gr, lemit, remit, join_type: JoinType):
     """Traceable plan. Returns (counts2, lo, m, bperm, un_mask):
-    counts2 = [n_primary, n_unmatched_b] (int32), the rest are the device
-    arrays `join_materialize_gids` consumes."""
+    counts2 = [n_primary, n_unmatched_b] (int64 under x64, else int32),
+    the rest are the device arrays `join_materialize_gids` consumes."""
     if join_type == JoinType.RIGHT:
         ga, gb, aemit, bemit = gr, gl, remit, lemit
     else:
@@ -216,18 +218,21 @@ def join_plan_gids(gl, gr, lemit, remit, join_type: JoinType):
     _, bperm = jax.lax.sort((gbm, biota), num_keys=1)
     # gid-sorted b order puts sentinel rows FIRST; `lo` counts them too
     # (#b with smaller gid), so run positions stay consistent.
+    # accumulate counts in int64 (where x64 is enabled) so >2^31-pair
+    # outputs don't silently wrap before the host capacity decision
+    cdt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     if join_type == JoinType.INNER:
-        n_primary = m.sum()
+        n_primary = m.sum(dtype=cdt)
     else:
-        n_primary = jnp.where(aemit, jnp.maximum(m, 1), 0).sum()
+        n_primary = jnp.where(aemit, jnp.maximum(m, 1), 0).sum(dtype=cdt)
     if join_type == JoinType.FULL_OUTER:
         _, mb = _match_lo_m(gbm, gam)
         un_mask = bemit & (mb == 0)
-        n_un = un_mask.sum()
+        n_un = un_mask.sum(dtype=cdt)
     else:
         un_mask = jnp.zeros(nb, bool)
-        n_un = jnp.int32(0)
-    counts2 = jnp.stack([n_primary, n_un]).astype(jnp.int32)
+        n_un = jnp.zeros((), cdt)
+    counts2 = jnp.stack([n_primary, n_un])
     return counts2, lo, m, bperm, un_mask
 
 

@@ -98,10 +98,7 @@ def _join_plan_fn(mesh, join_type: _join.JoinType):
 
     def kernel(lbits, lkv, lemit, rbits, rkv, remit):
         gl, gr = _shard_gids(lbits, lkv, rbits, rkv)
-        counts2, lo, m, bperm, un_mask = _join.join_plan_gids(
-            gl, gr, lemit, remit, join_type)
-        aemit = remit if join_type == _join.JoinType.RIGHT else lemit
-        return counts2, lo, m, bperm, un_mask, aemit
+        return _join.join_plan_gids(gl, gr, lemit, remit, join_type)
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
                              out_specs=spec))
@@ -121,7 +118,7 @@ def _join_mat_fn(mesh, join_type: _join.JoinType, cap_p: int, cap_u: int):
         rod, rov = _gather_side(rdat, rval, ridx)
         return lod, lov, rod, rov, emit
 
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 10,
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 9,
                              out_specs=spec))
 
 
@@ -264,23 +261,19 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig
 
     (lkb, lkv, lemit, ldat, lval), (rkb, rkv, remit, rdat, rval) = shuffled
 
-    counts = np.asarray(jax.device_get(_join_count_fn(ctx.mesh)(
-        lkb, lkv, lemit, rkb, rkv, remit))).reshape(world, 4)
-    n_inner, n_left, n_right, n_full = (counts[:, 0], counts[:, 1],
-                                        counts[:, 2], counts[:, 3])
     jt = config.type
-    if jt == _join.JoinType.INNER:
-        cap_l, cap_u = _pow2(int(n_inner.max())), 0
-    elif jt == _join.JoinType.LEFT:
-        cap_l, cap_u = _pow2(int(n_left.max())), 0
-    elif jt == _join.JoinType.RIGHT:
-        cap_l, cap_u = _pow2(int(n_right.max())), 0
-    else:
-        cap_l = _pow2(int(n_left.max()))
-        cap_u = _pow2(int((n_full - n_left).max()))
+    counts2, lo, m, bperm, un_mask = _join_plan_fn(ctx.mesh, jt)(
+        lkb, lkv, lemit, rkb, rkv, remit)
+    aemit = remit if jt == _join.JoinType.RIGHT else lemit
+    # counts2 concatenates each shard's [n_primary, n_unmatched_b] pair;
+    # capacity = pow2 of the worst shard (all shards share one program)
+    counts = np.asarray(jax.device_get(counts2)).reshape(world, 2)
+    cap_p = _pow2(int(counts[:, 0].max()))
+    cap_u = _pow2(int(counts[:, 1].max())) \
+        if jt == _join.JoinType.FULL_OUTER else 0
 
-    lod, lov, rod, rov, emit = _join_mat_fn(ctx.mesh, jt, cap_l, cap_u)(
-        lkb, lkv, lemit, rkb, rkv, remit, ldat, lval, rdat, rval)
+    lod, lov, rod, rov, emit = _join_mat_fn(ctx.mesh, jt, cap_p, cap_u)(
+        lo, m, bperm, un_mask, aemit, ldat, lval, rdat, rval)
 
     nl = left_d.column_count
     cols = _rebuild_columns(lod, lov, left_d,
