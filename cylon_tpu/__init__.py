@@ -12,6 +12,7 @@ from .config import (CommConfig, CommType, CSVReadOptions, CSVWriteOptions,
                      LocalConfig, MPIConfig, MultiHostConfig, ParquetOptions,
                      TPUConfig)
 from .context import CylonContext
+from . import telemetry
 from .data.column import Column
 from .data.row import Row
 from .data.table import Table, concat_tables, join, set_op
@@ -30,5 +31,6 @@ __all__ = [
     "DataType", "JoinAlgorithm", "JoinConfig", "JoinType", "Layout",
     "LocalConfig", "MPIConfig", "MultiHostConfig", "ParquetOptions", "Row",
     "Status", "TPUConfig", "Table", "Type", "concat_tables", "join",
-    "read_csv", "read_parquet", "set_op", "write_csv", "write_parquet",
+    "read_csv", "read_parquet", "set_op", "telemetry", "write_csv",
+    "write_parquet",
 ]

@@ -33,6 +33,7 @@ from ..config import CSVWriteOptions
 from ..context import CylonContext
 from ..status import Code, CylonError
 from .column import Column, unify_dictionaries
+from .. import telemetry as _telemetry
 from ..ops import aggregates as _aggregates
 from ..ops import groupby as _groupby
 from ..ops import join as _join
@@ -45,7 +46,8 @@ class Table:
                  row_mask=None):
         self._columns = columns
         self._ctx = ctx or CylonContext.Init()
-        self.row_mask = row_mask  # bool [n] or None (all rows live)
+        self._row_count_cache: Optional[int] = None
+        self._row_mask = row_mask  # bool [n] or None (all rows live)
         if columns:
             n = len(columns[0])
             for c in columns:
@@ -69,12 +71,27 @@ class Table:
         return len(self._columns)
 
     @property
+    def row_mask(self):
+        """Row-validity mask: bool [capacity] or None (all rows live)."""
+        return self._row_mask
+
+    @row_mask.setter
+    def row_mask(self, mask) -> None:
+        self._row_mask = mask
+        self._row_count_cache = None
+
+    @property
     def row_count(self) -> int:
+        """Live row count. Masked tables sync ONE scalar to the host on
+        first access; the result is cached (columns/mask never change
+        after construction — mutators like clear() reset the cache)."""
         if not self._columns:
             return 0
         if self.row_mask is None:
             return len(self._columns[0])
-        return int(self.row_mask.sum())
+        if self._row_count_cache is None:
+            self._row_count_cache = int(self.row_mask.sum())
+        return self._row_count_cache
 
     def columns(self) -> List[Column]:
         return self._columns
@@ -208,6 +225,7 @@ class Table:
     def clear(self) -> None:
         self._columns = []
         self.row_mask = None
+        self._row_count_cache = None
 
     def retain_memory(self, retain: bool = True) -> None:
         """Reference: Table::retainMemory (table.hpp:178) — a free-after-use
@@ -357,9 +375,9 @@ class Table:
         if self.row_mask is not None:
             valid = col.valid_mask() & self.emit_mask()
             col = Column(col.data, col.dtype, valid, col.dictionary, col.name)
+        # a sharded column's reduction already spans all shards (XLA
+        # inserts the cross-chip all-reduce) — no distributed branch needed
         value = _aggregates.agg_scalar(col, op)
-        if self._ctx.is_distributed():
-            pass  # arrays are global; reduction already spans all shards
         return Table.from_pydict(self._ctx, {col.name: [value]})
 
     def sum(self, column) -> "Table":
@@ -608,9 +626,12 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     rkvalid = tuple(c.validity for c in rcols)
     lemit, remit = left.row_mask, right.row_mask
 
-    counts2, lo, m, bperm, un_mask = _join.plan_program(
-        lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags, config.type)
-    n_primary, n_un = (int(v) for v in jax.device_get(counts2))
+    seq = left._ctx.get_next_sequence()
+    with _telemetry.phase("join.plan", seq):
+        counts2, lo, m, bperm, un_mask = _join.plan_program(
+            lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags,
+            config.type)
+        n_primary, n_un = (int(v) for v in jax.device_get(counts2))
     cap_p = _pow2(n_primary)
     cap_u = _pow2(n_un) if config.type == _join.JoinType.FULL_OUTER else 0
     aemit = remit if config.type == _join.JoinType.RIGHT else lemit
@@ -619,9 +640,10 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     lval = tuple(c.validity for c in left._columns)
     rdat = tuple(c.data for c in right._columns)
     rval = tuple(c.validity for c in right._columns)
-    lod, lov, rod, rov, emit = _join.materialize_program(
-        lo, m, bperm, un_mask, aemit,
-        ldat, lval, rdat, rval, config.type, cap_p, cap_u)
+    with _telemetry.phase("join.materialize", seq):
+        lod, lov, rod, rov, emit = _join.materialize_program(
+            lo, m, bperm, un_mask, aemit,
+            ldat, lval, rdat, rval, config.type, cap_p, cap_u)
 
     nl = left.column_count
     cols = [Column(d, c.dtype, v, c.dictionary, f"lt-{i}")
@@ -717,25 +739,25 @@ def groupby_local(table: Table, index_col, aggregate_cols: List,
     rep, group_valid, results = _groupby.segment_aggregate(
         gid, values, valids, emit, cap, tuple(ops))
 
-    # materialize: keep groups that exist (gid space may have holes when
-    # masked rows held their own ids — group_valid filters them)
-    gv = np.asarray(jax.device_get(group_valid))
-    live = np.flatnonzero(gv)
-    rep_h = jnp.asarray(np.asarray(jax.device_get(rep))[live])
-
-    out_cols = [table._columns[i].take(rep_h) for i in idx_cols]
+    # materialize at pow2 group capacity: dead slots (gid-space holes from
+    # masked rows, pow2 padding) stay on device masked via row_mask —
+    # num_groups above was the only host sync in this op
+    safe = jnp.minimum(rep, max(table.capacity - 1, 0))
+    out_cols = []
+    for i in idx_cols:
+        g = table._columns[i].take(safe)
+        validity = None if g.validity is None else g.validity & group_valid
+        out_cols.append(Column(g.data, g.dtype, validity, g.dictionary,
+                               g.name))
     for (arr, avalid), vi, op in zip(results, val_cols, aggregate_ops):
         src = table._columns[vi]
-        col = Column(arr[jnp.asarray(live)], _agg_dtype(src, op),
-                     avalid[jnp.asarray(live)],
-                     src.dictionary if op in (_groupby.AggregationOp.MIN,
-                                              _groupby.AggregationOp.MAX)
-                     and src.is_string else None,
-                     src.name)
-        if col.validity is not None and bool(col.validity.all()):
-            col.validity = None
-        out_cols.append(col)
-    return Table(out_cols, table._ctx)
+        out_cols.append(Column(
+            arr, _agg_dtype(src, op), avalid & group_valid,
+            src.dictionary if op in (_groupby.AggregationOp.MIN,
+                                     _groupby.AggregationOp.MAX)
+            and src.is_string else None,
+            src.name))
+    return Table(out_cols, table._ctx, group_valid)
 
 
 def _agg_dtype(src: Column, op) -> dtypes.DataType:

@@ -46,6 +46,7 @@ from ..ops import join as _join
 from ..ops import order as _order
 from ..ops import setops as _setops
 from ..status import Code, CylonError
+from ..telemetry import phase as _phase
 from . import shard
 from .shuffle import exchange, _pow2
 
@@ -243,37 +244,42 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig
     lidx, ridx = config.left_column_idx, config.right_column_idx
     lcols, rcols = table_mod.align_key_columns(left_d, right_d, lidx, ridx)
 
+    seq = ctx.get_next_sequence()
     shuffled = []
-    for t, kcols in ((left_d, lcols), (right_d, rcols)):
-        targets = shard.pin(_hash.partition_targets(kcols, world), ctx)
-        bits = _order.sort_keys(kcols)
-        kv = _all_valid(kcols)
-        payload = _table_payload(t)
-        for j, b in enumerate(bits):
-            payload[f"k{j}"] = b
-        payload["kv"] = kv
-        payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
-        out, emit, _cap = exchange(payload, targets,
-                                   shard.pin(t.emit_mask(), ctx), ctx)
-        kbits = tuple(out[f"k{j}"] for j in range(len(bits)))
-        dat, val = _payload_tuples(out, t.column_count)
-        shuffled.append((kbits, out["kv"], emit, dat, val))
+    with _phase("distributed_join.shuffle", seq):
+        for t, kcols in ((left_d, lcols), (right_d, rcols)):
+            targets = shard.pin(_hash.partition_targets(kcols, world), ctx)
+            bits = _order.sort_keys(kcols)
+            kv = _all_valid(kcols)
+            payload = _table_payload(t)
+            for j, b in enumerate(bits):
+                payload[f"k{j}"] = b
+            payload["kv"] = kv
+            payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
+            out, emit, _cap = exchange(payload, targets,
+                                       shard.pin(t.emit_mask(), ctx), ctx)
+            kbits = tuple(out[f"k{j}"] for j in range(len(bits)))
+            dat, val = _payload_tuples(out, t.column_count)
+            shuffled.append((kbits, out["kv"], emit, dat, val))
 
     (lkb, lkv, lemit, ldat, lval), (rkb, rkv, remit, rdat, rval) = shuffled
 
     jt = config.type
-    counts2, lo, m, bperm, un_mask = _join_plan_fn(ctx.mesh, jt)(
-        lkb, lkv, lemit, rkb, rkv, remit)
-    aemit = remit if jt == _join.JoinType.RIGHT else lemit
-    # counts2 concatenates each shard's [n_primary, n_unmatched_b] pair;
-    # capacity = pow2 of the worst shard (all shards share one program)
-    counts = np.asarray(jax.device_get(counts2)).reshape(world, 2)
+    with _phase("distributed_join.plan", seq):
+        counts2, lo, m, bperm, un_mask = _join_plan_fn(ctx.mesh, jt)(
+            lkb, lkv, lemit, rkb, rkv, remit)
+        aemit = remit if jt == _join.JoinType.RIGHT else lemit
+        # counts2 concatenates each shard's [n_primary, n_unmatched_b]
+        # pair; capacity = pow2 of the worst shard (all shards share one
+        # program)
+        counts = np.asarray(jax.device_get(counts2)).reshape(world, 2)
     cap_p = _pow2(int(counts[:, 0].max()))
     cap_u = _pow2(int(counts[:, 1].max())) \
         if jt == _join.JoinType.FULL_OUTER else 0
 
-    lod, lov, rod, rov, emit = _join_mat_fn(ctx.mesh, jt, cap_p, cap_u)(
-        lo, m, bperm, un_mask, aemit, ldat, lval, rdat, rval)
+    with _phase("distributed_join.materialize", seq):
+        lod, lov, rod, rov, emit = _join_mat_fn(ctx.mesh, jt, cap_p, cap_u)(
+            lo, m, bperm, un_mask, aemit, ldat, lval, rdat, rval)
 
     nl = left_d.column_count
     cols = _rebuild_columns(lod, lov, left_d,
@@ -304,38 +310,42 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
     has_validity = [a.validity is not None or b.validity is not None
                     for a, b in zip(lcols, rcols)]
 
+    seq = ctx.get_next_sequence()
     shuffled = []
-    for cols in (lcols, rcols):
-        t_emit = (left_d if cols is lcols else right_d).emit_mask()
-        targets = shard.pin(_hash.partition_targets(cols, world), ctx)
-        payload = {}
-        nbits = 0
-        for ci, c in enumerate(cols):
-            payload[f"d{ci}"] = c.data
-            payload[f"v{ci}"] = c.valid_mask()
-            payload[f"k{nbits}"] = _order.sort_keys([c])[0]
-            nbits += 1
-            if has_validity[ci]:
-                # validity participates in the row key (nulls compare equal,
-                # matching the reference's set-distinct semantics)
-                payload[f"k{nbits}"] = c.valid_mask().astype(jnp.uint8)
+    with _phase("distributed_set_op.shuffle", seq):
+        for cols in (lcols, rcols):
+            t_emit = (left_d if cols is lcols else right_d).emit_mask()
+            targets = shard.pin(_hash.partition_targets(cols, world), ctx)
+            payload = {}
+            nbits = 0
+            for ci, c in enumerate(cols):
+                payload[f"d{ci}"] = c.data
+                payload[f"v{ci}"] = c.valid_mask()
+                payload[f"k{nbits}"] = _order.sort_keys([c])[0]
                 nbits += 1
-        payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
-        out, emit, _cap = exchange(payload, targets, shard.pin(t_emit, ctx),
-                                   ctx)
-        kbits = tuple(out[f"k{j}"] for j in range(nbits))
-        dat, val = _payload_tuples(out, len(cols))
-        shuffled.append((kbits, emit, dat, val))
+                if has_validity[ci]:
+                    # validity participates in the row key (nulls compare
+                    # equal, matching the reference's set-distinct semantics)
+                    payload[f"k{nbits}"] = c.valid_mask().astype(jnp.uint8)
+                    nbits += 1
+            payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
+            out, emit, _cap = exchange(payload, targets,
+                                       shard.pin(t_emit, ctx), ctx)
+            kbits = tuple(out[f"k{j}"] for j in range(nbits))
+            dat, val = _payload_tuples(out, len(cols))
+            shuffled.append((kbits, emit, dat, val))
 
     (lkb, lemit, ldat, lval), (rkb, remit, rdat, rval) = shuffled
 
-    counts = np.asarray(jax.device_get(_setop_count_fn(ctx.mesh)(
-        lkb, lemit, rkb, remit))).reshape(world, 3)
+    with _phase("distributed_set_op.count", seq):
+        counts = np.asarray(jax.device_get(_setop_count_fn(ctx.mesh)(
+            lkb, lemit, rkb, remit))).reshape(world, 3)
     total = counts[:, int(op)]
     cap = _pow2(int(total.max()))
 
-    od, ov, emit = _setop_mat_fn(ctx.mesh, op, cap)(
-        lkb, lemit, rkb, remit, ldat, lval, rdat, rval)
+    with _phase("distributed_set_op.materialize", seq):
+        od, ov, emit = _setop_mat_fn(ctx.mesh, op, cap)(
+            lkb, lemit, rkb, remit, ldat, lval, rdat, rval)
 
     cols = []
     for d, v, a in zip(od, ov, lcols):
@@ -365,18 +375,20 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
     val_cols = [t._col_index(c) for c in aggregate_cols]
     key_columns = [t._columns[i] for i in idx_cols]
 
-    targets = shard.pin(_hash.partition_targets(key_columns, world), ctx)
-    payload = {}
-    for j, c in enumerate(key_columns):
-        payload[f"kb{j}"] = _order.sort_keys([c])[0]
-        payload[f"kd{j}"] = c.data
-        payload[f"kv{j}"] = c.valid_mask()
-    for j, vi in enumerate(val_cols):
-        payload[f"d{j}"] = t._columns[vi].data
-        payload[f"v{j}"] = t._columns[vi].valid_mask()
-    payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
-    out, emit, _cap = exchange(payload, targets, shard.pin(t.emit_mask(), ctx),
-                               ctx)
+    seq = ctx.get_next_sequence()
+    with _phase("distributed_groupby.shuffle", seq):
+        targets = shard.pin(_hash.partition_targets(key_columns, world), ctx)
+        payload = {}
+        for j, c in enumerate(key_columns):
+            payload[f"kb{j}"] = _order.sort_keys([c])[0]
+            payload[f"kd{j}"] = c.data
+            payload[f"kv{j}"] = c.valid_mask()
+        for j, vi in enumerate(val_cols):
+            payload[f"d{j}"] = t._columns[vi].data
+            payload[f"v{j}"] = t._columns[vi].valid_mask()
+        payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
+        out, emit, _cap = exchange(payload, targets,
+                                   shard.pin(t.emit_mask(), ctx), ctx)
 
     nk, nv = len(idx_cols), len(val_cols)
     kbits = tuple(out[f"kb{j}"] for j in range(nk))
@@ -386,8 +398,9 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
     vval = tuple(out[f"v{j}"] for j in range(nv))
 
     ops = tuple(aggregate_ops)
-    kout, kvout, gvalid, agg = _groupby_fn(ctx.mesh, ops)(
-        kbits, kdat, kval, emit, vdat, vval)
+    with _phase("distributed_groupby.aggregate", seq):
+        kout, kvout, gvalid, agg = _groupby_fn(ctx.mesh, ops)(
+            kbits, kdat, kval, emit, vdat, vval)
 
     cols = []
     for d, v, src_i in zip(kout, kvout, idx_cols):
@@ -416,14 +429,17 @@ def distributed_sort(table: Table, order_by, ascending=True) -> Table:
     idxs = [t._col_index(c) for c in by]
     asc = list(ascending) if isinstance(ascending, (list, tuple)) \
         else [ascending] * len(idxs)
-    keys = _order.sort_keys([t._columns[i] for i in idxs], asc)
-    emit = t.emit_mask()
-    dead_last = (~emit).astype(jnp.uint8)  # live rows first, padding at tail
-    perm = _order.lexsort_indices([dead_last] + keys)
-    cols = []
-    for c in t._columns:
-        g = c.take(perm)
-        validity = None if g.validity is None else shard.pin(g.validity, ctx)
-        cols.append(Column(shard.pin(g.data, ctx), g.dtype, validity,
-                           g.dictionary, g.name))
-    return Table(cols, ctx, shard.pin(jnp.take(emit, perm), ctx))
+    with _phase("distributed_sort", ctx.get_next_sequence()):
+        keys = _order.sort_keys([t._columns[i] for i in idxs], asc)
+        emit = t.emit_mask()
+        # live rows first, padding at the tail
+        dead_last = (~emit).astype(jnp.uint8)
+        perm = _order.lexsort_indices([dead_last] + keys)
+        cols = []
+        for c in t._columns:
+            g = c.take(perm)
+            validity = None if g.validity is None \
+                else shard.pin(g.validity, ctx)
+            cols.append(Column(shard.pin(g.data, ctx), g.dtype, validity,
+                               g.dictionary, g.name))
+        return Table(cols, ctx, shard.pin(jnp.take(emit, perm), ctx))

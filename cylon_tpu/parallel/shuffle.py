@@ -39,6 +39,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from ..context import CylonContext
+from ..telemetry import phase as _phase
 from ..util import pow2 as _pow2
 from .shard import row_sharding
 
@@ -112,10 +113,14 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
     world = ctx.get_world_size()
     if "__emit__" in payload:
         raise ValueError("__emit__ is a reserved payload key")
-    counts = np.asarray(jax.device_get(_count_fn(ctx.mesh)(targets, emit)))
+    seq = ctx.get_next_sequence()
+    with _phase("shuffle.count", seq):
+        counts = np.asarray(jax.device_get(_count_fn(ctx.mesh)(targets,
+                                                               emit)))
     block = _pow2(int(counts.max()) if counts.size else 1)
     full = dict(payload)
     full["__emit__"] = emit
-    out = _exchange_fn(ctx.mesh, block)(full, targets, emit)
+    with _phase("shuffle.exchange", seq):
+        out = _exchange_fn(ctx.mesh, block)(full, targets, emit)
     new_emit = out.pop("__emit__")
     return out, new_emit, world * block

@@ -1,0 +1,53 @@
+"""Phase timing + profiler annotation.
+
+The reference's observability is pervasive manual wall-clock timing with
+glog at every operator phase (reference: cpp/src/cylon/table.cpp:320-335
+shuffle timing; join/join.cpp:101-253 per-phase logs; arrow_hash_kernels.hpp
+:120,163 build/probe timers). Here the same discipline rides two carriers:
+
+* a ``logging`` logger named ``cylon_tpu`` — ``phase(name, seq)`` logs the
+  host-side elapsed time per operator phase at INFO. JAX dispatch is async:
+  unless a phase ends in a host sync (the count→materialize scalar fetches
+  do), the time logged is dispatch+trace cost, not device time. That is
+  exactly what the phase discipline is for — spotting recompiles and host
+  round-trips, the things the host can see.
+* ``jax.profiler.TraceAnnotation`` — the same label appears in TensorBoard
+  / Perfetto traces captured with ``jax.profiler.trace``, where the DEVICE
+  time lives. ``seq`` carries the context's op sequence number, the moral
+  heir of the reference's MPI edge/tag id (ctx/cylon_context.cpp:94-99).
+
+Enable host-side logs with ``logging.getLogger("cylon_tpu").setLevel(
+logging.INFO)`` plus a handler, or ``cylon_tpu.telemetry.log_to_stderr()``.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+import jax
+
+logger = logging.getLogger("cylon_tpu")
+
+
+def log_to_stderr(level: int = logging.INFO) -> None:
+    """Convenience: route cylon_tpu phase logs to stderr (idempotent)."""
+    if not any(getattr(h, "_cylon_tpu", False) for h in logger.handlers):
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(message)s"))
+        handler._cylon_tpu = True
+        logger.addHandler(handler)
+    logger.setLevel(level)
+
+
+@contextmanager
+def phase(name: str, seq: Optional[int] = None) -> Iterator[None]:
+    """Time one operator phase; annotate device traces with the same label."""
+    label = f"{name}#{seq}" if seq is not None else name
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(f"cylon:{label}"):
+        yield
+    if logger.isEnabledFor(logging.INFO):
+        logger.info("%s %.3f ms", label, (time.perf_counter() - t0) * 1e3)

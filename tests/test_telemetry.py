@@ -1,0 +1,49 @@
+"""Phase-timer/observability tests (the reference's timing-log discipline,
+reference: cpp/src/cylon/table.cpp:320-335)."""
+import logging
+
+import numpy as np
+
+
+def test_phase_logs_emitted(local_ctx, caplog):
+    import cylon_tpu as ct
+
+    t1 = ct.Table.from_pydict(local_ctx, {"k": np.arange(64) % 8,
+                                          "v": np.arange(64.0)})
+    t2 = ct.Table.from_pydict(local_ctx, {"k": np.arange(64) % 8,
+                                          "w": np.arange(64.0)})
+    with caplog.at_level(logging.INFO, logger="cylon_tpu"):
+        t1.join(t2, "inner", on="k")
+    msgs = [r.message for r in caplog.records]
+    assert any(m.startswith("join.plan#") for m in msgs), msgs
+    assert any(m.startswith("join.materialize#") for m in msgs), msgs
+
+
+def test_dist_phase_logs(dist_ctx, caplog):
+    import cylon_tpu as ct
+
+    t1 = ct.Table.from_pydict(dist_ctx, {"k": np.arange(64) % 8,
+                                         "v": np.arange(64.0)})
+    t2 = ct.Table.from_pydict(dist_ctx, {"k": np.arange(64) % 8,
+                                         "w": np.arange(64.0)})
+    with caplog.at_level(logging.INFO, logger="cylon_tpu"):
+        t1.distributed_join(t2, "inner", on="k")
+    msgs = [r.message for r in caplog.records]
+    for prefix in ("distributed_join.shuffle#", "distributed_join.plan#",
+                   "distributed_join.materialize#", "shuffle.count#",
+                   "shuffle.exchange#"):
+        assert any(m.startswith(prefix) for m in msgs), (prefix, msgs)
+
+
+def test_row_count_cached(local_ctx):
+    import jax.numpy as jnp
+
+    import cylon_tpu as ct
+
+    t = ct.Table.from_pydict(local_ctx, {"k": np.arange(16)})
+    t.row_mask = jnp.arange(16) < 10
+    assert t.row_count == 10
+    assert t._row_count_cache == 10  # second access skips the device sync
+    assert t.row_count == 10
+    t.row_mask = jnp.arange(16) < 4  # setter invalidates the cache
+    assert t.row_count == 4
