@@ -1,4 +1,4 @@
-"""The shuffle: hash-partition + all-to-all exchange on XLA collectives.
+"""The shuffle: hash-partition + blockwise all-to-all on XLA collectives.
 
 This is the TPU-native replacement for the reference's entire four-layer
 communication stack (reference: cpp/src/cylon/net/mpi/mpi_channel.cpp:30-247
@@ -14,19 +14,28 @@ every body message) maps to the static-shape world as a TWO-PHASE exchange:
 
   phase 1 ("header"): a tiny compiled program computes the per-(src,dst)
      send-count matrix — one [W] vector per shard, gathered to the host;
-  phase 2 ("body"):   the host picks a pow2 block size B = max count (this
-     bounds recompilation to O(log) distinct programs), and a second
-     compiled program bucket-sorts rows by target shard, scatters them into
-     a [W, B] send buffer per column, and runs ONE `all_to_all` per column
-     over ICI. Padding slots carry emit=False.
+  phase 2 ("body"):   a BLOCKWISE exchange. The host picks a pow2 block
+     size B (capped at MAX_BLOCK) and a round count K with K*B >= the
+     largest single (src,dst) transfer; the compiled program bucket-sorts
+     rows by target once, then loops K rounds, each round moving one [W,B]
+     block per payload leaf through `all_to_all` and compacting received
+     rows into a [cap_out] output at running per-source offsets.
+
+The blockwise loop is the TPU analog of the reference's incremental
+buffer-at-a-time streaming (arrow_all_to_all.cpp:83-135): peak comm-buffer
+memory is bounded by W*MAX_BLOCK rows per leaf regardless of skew, and the
+output capacity tracks the worst RECEIVE TOTAL over shards
+(pow2(max_t sum_s C[s,t])) instead of W*pow2(max C[s,t]) — up to W× smaller
+when one (src,dst) pair is hot. Receivers place each source's rows
+contiguously, so shuffle output is COMPACT (emit = leading prefix).
 
 Rows whose emit mask is False (table padding, filtered rows) are dropped in
 transit — the shuffle doubles as a compaction step.
 """
 from __future__ import annotations
 
-from functools import lru_cache, partial
-from typing import Dict, Tuple
+from functools import lru_cache
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,7 +50,11 @@ except ImportError:  # pragma: no cover
 from ..context import CylonContext
 from ..telemetry import phase as _phase
 from ..util import pow2 as _pow2
-from .shard import row_sharding
+
+# Upper bound on the per-round block (rows per (src,dst) pair per round).
+# Comm/scratch memory per leaf is 2*W*MAX_BLOCK rows; skew beyond this
+# degrades into more rounds, not bigger buffers.
+MAX_BLOCK = 1 << 16
 
 
 @lru_cache(maxsize=None)
@@ -65,9 +78,9 @@ def _count_fn(mesh):
 
 
 @lru_cache(maxsize=None)
-def _exchange_fn(mesh, block: int):
-    """The body phase: bucket-sort by target, scatter to [W, B] blocks,
-    one `all_to_all` per payload leaf, flatten back to [W*B] rows."""
+def _exchange_fn(mesh, block: int, rounds: int, cap_out: int):
+    """The body phase: bucket-sort by target once, then K blockwise
+    `all_to_all` rounds compacting into a [cap_out] output per leaf."""
     axis = mesh.axis_names[0]
     world = mesh.devices.size
     spec = P(axis)
@@ -79,48 +92,88 @@ def _exchange_fn(mesh, block: int):
         # stable bucket sort by target: one fused device sort yields the
         # permutation every column reuses (the reference's per-dtype split
         # kernels, arrow_kernels.cpp:24-134, collapse into this one sort)
-        t_sorted, perm = jax.lax.sort((t, iota), num_keys=1)
-        counts = jax.ops.segment_sum(jnp.ones(n, jnp.int32), t,
-                                     num_segments=world + 1)[:world]
-        start = jnp.cumsum(counts) - counts
-        pos = iota - jnp.take(start, jnp.minimum(t_sorted, world - 1))
-        flat = jnp.where(t_sorted < world, t_sorted * block + pos,
-                         world * block)  # out-of-range -> dropped
+        _, perm = jax.lax.sort((t, iota), num_keys=1)
+        counts_out = jax.ops.segment_sum(jnp.ones(n, jnp.int32), t,
+                                         num_segments=world + 1)[:world]
+        start = jnp.cumsum(counts_out) - counts_out
+        # the header exchange, on device: each shard learns how many rows
+        # every source will send it, and writes source s's rows at offset
+        # S[s] — arrivals are contiguous per source, output is compact
+        counts_in = jax.lax.all_to_all(counts_out, axis, split_axis=0,
+                                       concat_axis=0, tiled=True)
+        S = jnp.cumsum(counts_in) - counts_in
+        total_in = counts_in.sum()
 
-        def exchange_leaf(x):
-            xs = jnp.take(x, perm, axis=0)
-            buf = jnp.zeros((world * block,) + x.shape[1:], x.dtype)
-            buf = buf.at[flat].set(xs, mode="drop")
-            buf = buf.reshape((world, block) + x.shape[1:])
-            out = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
-                                     tiled=False)
-            return out.reshape((world * block,) + x.shape[1:])
+        biota = jnp.arange(block, dtype=jnp.int32)[None, :]      # [1,B]
+        sorted_leaves = jax.tree.map(
+            lambda x: jnp.take(x, perm, axis=0), payload)
+        # the carry must be typed as mesh-varying, like the all_to_all
+        # outputs accumulated into it
+        _vary = getattr(jax.lax, "pcast", None)
+        if _vary is not None:
+            def _to_varying(x):
+                return jax.lax.pcast(x, axis, to="varying")
+        else:  # pragma: no cover - older jax
+            def _to_varying(x):
+                return jax.lax.pvary(x, (axis,))
+        out0 = jax.tree.map(
+            lambda x: _to_varying(jnp.zeros((cap_out,) + x.shape[1:],
+                                            x.dtype)), payload)
 
-        return jax.tree.map(exchange_leaf, payload)
+        def round_body(k, outs):
+            o = k * block
+            # send slots: rows [o, o+B) of each target's bucket
+            gsafe = jnp.clip(start[:, None] + o + biota, 0, max(n - 1, 0))
+            # receive slots: S[s] + [o, o+B), dropped past counts_in[s]
+            pos = S[:, None] + o + biota
+            pvalid = (o + biota) < counts_in[:, None]
+            psafe = jnp.where(pvalid, pos, cap_out).reshape(-1)
+
+            def one(xs, out):
+                send = jnp.take(xs, gsafe.reshape(-1), axis=0)
+                send = send.reshape((world, block) + xs.shape[1:])
+                recv = jax.lax.all_to_all(send, axis, split_axis=0,
+                                          concat_axis=0, tiled=False)
+                flat = recv.reshape((world * block,) + xs.shape[1:])
+                return out.at[psafe].set(flat, mode="drop")
+
+            return jax.tree.map(one, sorted_leaves, outs)
+
+        outs = jax.lax.fori_loop(0, rounds, round_body, out0) if rounds > 1 \
+            else round_body(0, out0)
+        new_emit = jnp.arange(cap_out, dtype=jnp.int32) < total_in
+        return outs, new_emit
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec))
 
 
 def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
-             emit: jnp.ndarray, ctx: CylonContext
+             emit: jnp.ndarray, ctx: CylonContext,
+             max_block: Optional[int] = None
              ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, int]:
     """Shuffle a pytree of row-sharded per-row arrays to their target shards.
 
     Returns (exchanged payload, new emit mask, per-shard capacity). All
-    outputs are row-sharded; capacity = W * B where B is the pow2 block.
+    outputs are row-sharded and COMPACT per shard (live rows form a
+    leading prefix). Capacity = pow2 of the worst per-shard receive total.
+    ``max_block`` caps the per-round block size (default MAX_BLOCK).
     """
     world = ctx.get_world_size()
-    if "__emit__" in payload:
-        raise ValueError("__emit__ is a reserved payload key")
     seq = ctx.get_next_sequence()
     with _phase("shuffle.count", seq):
-        counts = np.asarray(jax.device_get(_count_fn(ctx.mesh)(targets,
-                                                               emit)))
-    block = _pow2(int(counts.max()) if counts.size else 1)
-    full = dict(payload)
-    full["__emit__"] = emit
+        counts = np.asarray(jax.device_get(
+            _count_fn(ctx.mesh)(targets, emit))).reshape(world, world)
+    max_pair = int(counts.max()) if counts.size else 0
+    recv_max = int(counts.sum(axis=0).max()) if counts.size else 0
+    mb = max_block if max_block is not None else MAX_BLOCK
+    # floor-pow2 the cap so the documented memory bound is never exceeded
+    mb = 1 << (max(int(mb), 1).bit_length() - 1)
+    block = min(_pow2(max_pair), mb)
+    # pow2 round count bounds the compile cache to O(log^3) programs
+    rounds = _pow2(-(-max(max_pair, 1) // block))
+    cap_out = _pow2(recv_max)
     with _phase("shuffle.exchange", seq):
-        out = _exchange_fn(ctx.mesh, block)(full, targets, emit)
-    new_emit = out.pop("__emit__")
-    return out, new_emit, world * block
+        out, new_emit = _exchange_fn(ctx.mesh, block, rounds, cap_out)(
+            payload, targets, emit)
+    return out, new_emit, cap_out

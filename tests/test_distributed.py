@@ -243,3 +243,81 @@ def test_world1_distributed_falls_back_to_local():
     b = ct.Table.from_pydict(ctx, {"k": [2, 3], "u": [10, 20]})
     j = a.distributed_join(b, "inner", on="k")
     assert j.row_count == 2
+
+
+# ---------------------------------------------------------------------------
+# blockwise ragged exchange: skew capacity + multi-round correctness
+# (reference mechanism: incremental buffer-at-a-time streaming,
+# arrow_all_to_all.cpp:83-135; SURVEY §5.7)
+# ---------------------------------------------------------------------------
+
+def test_skew_capacity_tracks_receive_total(dist_ctx8):
+    """A hot (src,dst) pair must NOT inflate every shard's buffer to
+    W * max_pair: output capacity tracks the worst receive TOTAL."""
+    world = dist_ctx8.get_world_size()
+    n = 1 << 20
+    keys = np.empty(n, np.int64)
+    # SOURCE skew: the first 1/8 of rows (= one source shard) all carry
+    # the hot key; the rest are uniform over many keys
+    hot = n // world
+    keys[:hot] = 0
+    rng = np.random.default_rng(12)
+    keys[hot:] = rng.integers(1, 1 << 20, n - hot)
+    t = ct.Table.from_pydict(dist_ctx8, {"k": keys})
+    s = dist_ops.shuffle(t, ["k"])
+    assert s.row_count == n
+    per_shard_cap = s.capacity // world
+    # worst receive total ~ hot + n/W uniform share; W*max_pair would be
+    # ~ W*hot = n. Assert we are well under the old W*max_pair regime.
+    assert per_shard_cap <= 4 * hot, \
+        f"per-shard capacity {per_shard_cap} vs hot count {hot}"
+
+
+def test_multi_round_exchange_matches_single(dist_ctx):
+    """Forcing tiny blocks (many rounds) must not change the result."""
+    import jax
+
+    from cylon_tpu.ops import hash as _hash
+    from cylon_tpu.parallel import shard as _shard
+    from cylon_tpu.parallel.shuffle import exchange
+
+    rng = np.random.default_rng(13)
+    n = 4096
+    t = distribute(ct.Table.from_pydict(
+        dist_ctx, {"a": rng.integers(0, 50, n), "b": rng.normal(size=n)}),
+        dist_ctx)
+    targets = _shard.pin(_hash.partition_targets([t.get_column(0)],
+                                                 dist_ctx.get_world_size()),
+                         dist_ctx)
+    emit = _shard.pin(t.emit_mask(), dist_ctx)
+    payload = {"a": _shard.pin(t.get_column(0).data, dist_ctx),
+               "b": _shard.pin(t.get_column(1).data, dist_ctx)}
+    big, be, _ = exchange(payload, targets, emit, dist_ctx)
+    small, se, _ = exchange(payload, targets, emit, dist_ctx, max_block=64)
+    ba = np.asarray(jax.device_get(big["a"]))[np.asarray(jax.device_get(be))]
+    sa = np.asarray(jax.device_get(small["a"]))[np.asarray(jax.device_get(se))]
+    bb = np.asarray(jax.device_get(big["b"]))[np.asarray(jax.device_get(be))]
+    sb = np.asarray(jax.device_get(small["b"]))[np.asarray(jax.device_get(se))]
+    assert ba.shape == sa.shape
+    # same multiset of (a, b) rows
+    bo = np.lexsort((bb, ba))
+    so = np.lexsort((sb, sa))
+    np.testing.assert_array_equal(ba[bo], sa[so])
+    np.testing.assert_allclose(bb[bo], sb[so])
+
+
+def test_dist_join_correct_under_hot_key(dist_ctx8):
+    """50%-hot key join correctness at moderate scale (duplicates explode
+    quadratically, so the hot key count is kept joinable)."""
+    rng = np.random.default_rng(14)
+    n = 2000
+    ka = np.where(rng.random(n) < 0.5, 0, rng.integers(1, 1000, n))
+    kb = np.where(rng.random(n) < 0.5, 0, rng.integers(1, 1000, n))
+    a = ct.Table.from_pydict(dist_ctx8, {"k": ka, "v": rng.normal(size=n)})
+    b = ct.Table.from_pydict(dist_ctx8, {"k": kb, "w": rng.normal(size=n)})
+    j = a.distributed_join(b, "inner", on="k")
+    la = ct.CylonContext.Init()
+    lj = ct.Table.from_pydict(la, {"k": ka, "v": np.zeros(n)}).join(
+        ct.Table.from_pydict(la, {"k": kb, "w": np.zeros(n)}), "inner",
+        on="k")
+    assert j.row_count == lj.row_count
