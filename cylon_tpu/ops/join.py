@@ -191,9 +191,10 @@ def _masked_indices(mask, out_size: int) -> jnp.ndarray:
 # plan / materialize. A join is TWO device programs separated by one
 # 2-scalar host sync (the static-shape capacity decision):
 #
-#   plan:        gids → match info (lo, m), gid-sorted b permutation,
-#                unmatched-b mask, output COUNTS. One match sort (+ one
-#                more for FULL_OUTER's unmatched side).
+#   plan:        key bits → match info (lo, m), key-sorted live-b
+#                permutation, unmatched-b mask, output COUNTS — all from
+#                ONE fused sort of the concatenated keys (see
+#                `join_plan_keys`).
 #   materialize: consumes the plan's DEVICE arrays — duplicate-run
 #                expansion + payload gathers. No re-sorting: the expensive
 #                match sort is computed once and reused across the phases.
@@ -203,30 +204,105 @@ def _masked_indices(mask, out_size: int) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def join_plan_gids(gl, gr, lemit, remit, join_type: JoinType):
-    """Traceable plan. Returns (counts2, lo, m, bperm, un_mask):
-    counts2 = [n_primary, n_unmatched_b] (int64 under x64, else int32),
-    the rest are the device arrays `join_materialize_gids` consumes."""
+def join_plan_keys(lbits, lkv, lemit, rbits, rkv, remit,
+                   join_type: JoinType):
+    """Traceable single-sort join plan.
+
+    Replaces a dense-rank sort + match sort + b-permutation sort (three
+    33M-element device sorts at bench scale) with ONE fused sort over the
+    concatenated keys, tagged by (class, side):
+
+      class: 0 = matchable (emitted AND key valid), 1 = dead left row,
+             2 = dead right row — dead rows sort into their own runs and
+             never match;
+      side:  within a key run, build (b) rows sort before probe (a) rows,
+             so at any a position the inclusive live-b prefix count minus
+             the count at the run head IS the run's match count.
+
+    Profiling note (TPU v5e): XLA gathers/scatters cost ~10-15 ns/element
+    regardless of locality, so this plan's cost model counts them — it
+    spends 1 sort + 2 cumsums + 1 gather + 4 scatters (FULL_OUTER adds 2
+    gathers + 1 scatter), versus 3 sorts + 4 gathers + 4 scatters for the
+    two-phase formulation it replaces.
+
+    Returns (counts2, lo, m, bperm, un_mask): counts2 = [n_primary,
+    n_unmatched_b] (int64 under x64, else int32); lo[i]/m[i] = start and
+    length of probe row i's match run inside `bperm` (the key-ordered
+    compaction of live build rows, original indices); un_mask marks
+    emitted build rows with no match (FULL_OUTER only).
+    """
     if join_type == JoinType.RIGHT:
-        ga, gb, aemit, bemit = gr, gl, remit, lemit
+        abits, akv, aemit = rbits, rkv, remit
+        bbits, bkv, bemit = lbits, lkv, lemit
     else:
-        ga, gb, aemit, bemit = gl, gr, lemit, remit
-    gam, gbm = _mask_gids(ga, gb, aemit, bemit)
-    nb = gbm.shape[0]
-    lo, m = _match_lo_m(gam, gbm)
-    biota = jnp.arange(nb, dtype=jnp.int32)
-    _, bperm = jax.lax.sort((gbm, biota), num_keys=1)
-    # gid-sorted b order puts sentinel rows FIRST; `lo` counts them too
-    # (#b with smaller gid), so run positions stay consistent.
+        abits, akv, aemit = lbits, lkv, lemit
+        bbits, bkv, bemit = rbits, rkv, remit
+    na, nb = aemit.shape[0], bemit.shape[0]
+    n = na + nb
+    cdt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+    if na == 0 or n == 0:
+        if join_type == JoinType.FULL_OUTER:
+            un_mask = bemit
+            n_un = un_mask.sum(dtype=cdt)
+        else:
+            un_mask = jnp.zeros(nb, bool)
+            n_un = jnp.zeros((), cdt)
+        counts2 = jnp.stack([jnp.zeros((), cdt), n_un])
+        z = jnp.zeros(na, jnp.int32)
+        return counts2, z, z, jnp.zeros(nb, jnp.int32), un_mask
+
+    live_a = aemit & akv
+    live_b = bemit & bkv
+    cls = jnp.concatenate([
+        jnp.where(live_a, 0, 1).astype(jnp.uint8),
+        jnp.where(live_b, 0, 2).astype(jnp.uint8)])
+    side = jnp.concatenate([jnp.ones(na, jnp.uint8),
+                            jnp.zeros(nb, jnp.uint8)])
+    iota = jnp.arange(n, dtype=jnp.int32)
+    bits = [jnp.concatenate([x, y]) for x, y in zip(abits, bbits)]
+    res = jax.lax.sort(tuple([cls] + bits + [side, iota]),
+                       num_keys=2 + len(bits))
+    cls_s, bits_s, side_s, idx_s = res[0], res[1:-2], res[-2], res[-1]
+
+    is_a = side_s == 1
+    ib = ((side_s == 0) & (cls_s == 0)).astype(jnp.int32)
+    cum_b = jnp.cumsum(ib)
+    neq_tail = cls_s[1:] != cls_s[:-1]
+    for k in bits_s:
+        neq_tail = neq_tail | (k[1:] != k[:-1])
+    neq = jnp.concatenate([jnp.ones(1, bool), neq_tail])
+    run_id = jnp.cumsum(neq.astype(jnp.int32)) - 1
+    # live-b count before each run, broadcast via run heads (scatter to
+    # unique head slots + gather by run id — never a cumulative max)
+    head_b = jnp.zeros(n, jnp.int32).at[
+        jnp.where(neq, run_id, n)].set(cum_b - ib, mode="drop")
+    b_before = jnp.take(head_b, run_id)
+    m_at = cum_b - b_before  # valid at a positions: run b's all precede
+
+    dest_a = jnp.where(is_a, idx_s, na)
+    lo = jnp.zeros(na, jnp.int32).at[dest_a].set(b_before, mode="drop")
+    m = jnp.zeros(na, jnp.int32).at[dest_a].set(m_at, mode="drop")
+    bperm = jnp.zeros(nb, jnp.int32).at[
+        jnp.where(ib == 1, cum_b - 1, nb)].set(idx_s - na, mode="drop")
+
     # accumulate counts in int64 (where x64 is enabled) so >2^31-pair
     # outputs don't silently wrap before the host capacity decision
-    cdt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     if join_type == JoinType.INNER:
         n_primary = m.sum(dtype=cdt)
     else:
         n_primary = jnp.where(aemit, jnp.maximum(m, 1), 0).sum(dtype=cdt)
     if join_type == JoinType.FULL_OUTER:
-        _, mb = _match_lo_m(gbm, gam)
+        ia = ((side_s == 1) & (cls_s == 0)).astype(jnp.int32)
+        cum_a = jnp.cumsum(ia)
+        head_a = jnp.zeros(n + 1, jnp.int32).at[
+            jnp.where(neq, run_id, n + 1)].set(cum_a - ia, mode="drop")
+        nruns = run_id[-1] + 1
+        head_a = head_a.at[nruns].set(cum_a[-1], mode="drop")
+        # live-a total of each run = next run's prefix minus this run's
+        m_b_at = jnp.take(head_a, run_id + 1) - jnp.take(head_a, run_id)
+        dest_b = jnp.where(side_s == 0, idx_s - na, nb)
+        mb = jnp.zeros(nb, jnp.int32).at[dest_b].set(m_b_at, mode="drop")
         un_mask = bemit & (mb == 0)
         n_un = un_mask.sum(dtype=cdt)
     else:
@@ -236,18 +312,31 @@ def join_plan_gids(gl, gr, lemit, remit, join_type: JoinType):
     return counts2, lo, m, bperm, un_mask
 
 
+def join_plan_gids(gl, gr, lemit, remit, join_type: JoinType):
+    """Plan from precomputed shared dense key ids (compat wrapper over
+    `join_plan_keys`): negative gids are null sentinels that never match."""
+    sb = jnp.uint32(1 << 31)
+    return join_plan_keys(
+        (gl.astype(jnp.uint32) ^ sb,), gl >= 0, lemit,
+        (gr.astype(jnp.uint32) ^ sb,), gr >= 0, remit, join_type)
+
+
 def _expand_from_match(lo, m, aemit, bperm, out_size: int,
                        emit_unmatched_a: bool
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Emit (a_idx, b_idx) pairs from precomputed match info, padded to
     ``out_size`` with (-1, -1).
 
-    B rows of a gid occupy a contiguous run of the gid-sorted b permutation
+    B rows of a key occupy a contiguous run of the key-sorted b permutation
     starting at lo; a row i's j-th output picks run slot j − first_output_i.
     The j→i map: scatter a 1 at each emitting run's start (unique slots),
     cumsum ranks positions into ordinal runs, and a gather through the
     compacted emitting-row list recovers i — no cumulative max (215 s
-    COMPILE at 2M) and no binary search."""
+    COMPILE at 2M) and no binary search.
+
+    Per-row plan values (lo − starts, has-match) are bit-packed into ONE
+    int32 so the output-sized re-gather happens once, not three times —
+    gathers cost ~10-15 ns/element on TPU and dominate this kernel."""
     na, nb = lo.shape[0], bperm.shape[0]
     if na == 0:
         e = jnp.full(out_size, -1, jnp.int32)
@@ -256,6 +345,9 @@ def _expand_from_match(lo, m, aemit, bperm, out_size: int,
     off = jnp.cumsum(mm)
     total = off[-1]
     starts = off - mm
+    # bpos = lo[i] + (j - starts[i]) = j + delta[i]; two's-complement
+    # arithmetic keeps (x*2+bit)>>1 == x for negative deltas
+    delta2 = (lo - starts) * 2 + (m > 0)
 
     aiota = jnp.arange(na, dtype=jnp.int32)
     erank = jnp.cumsum((mm > 0).astype(jnp.int32))  # inclusive
@@ -267,13 +359,14 @@ def _expand_from_match(lo, m, aemit, bperm, out_size: int,
     i = jnp.take(emit_list, jnp.maximum(c - 1, 0), mode="clip")
 
     j = jnp.arange(out_size, dtype=jnp.int32)
-    k = j - jnp.take(starts, i)
-    bpos = jnp.take(lo, i) + k
+    d2 = jnp.take(delta2, i)
+    has = (d2 & 1) == 1
     if nb == 0:
         bidx = jnp.full(out_size, -1, jnp.int32)
     else:
+        bpos = j + (d2 >> 1)
         bidx = jnp.take(bperm, bpos, mode="fill", fill_value=0)
-        bidx = jnp.where(jnp.take(m, i) > 0, bidx, -1)
+        bidx = jnp.where(has, bidx, -1)
     valid = j < total
     aidx = jnp.where(valid, i, -1)
     bidx = jnp.where(valid, bidx, -1)
@@ -313,7 +406,7 @@ def compute_gids(lbits, lkv, rbits, rkv):
             jnp.where(rkv, gr, RIGHT_NULL_GID))
 
 
-def _keys_to_gids(lkeys, lkvalid, rkeys, rkvalid, str_flags):
+def _keys_to_bits(lkeys, lkvalid, rkeys, rkvalid, str_flags):
     from .order import ordered_bits_raw
 
     n_l, n_r = lkeys[0].shape[0], rkeys[0].shape[0]
@@ -327,7 +420,7 @@ def _keys_to_gids(lkeys, lkvalid, rkeys, rkvalid, str_flags):
     for v in rkvalid:
         if v is not None:
             rkv = rkv & v
-    return compute_gids(lbits, lkv, rbits, rkv)
+    return lbits, lkv, rbits, rkv
 
 
 @partial(jax.jit, static_argnames=("str_flags", "join_type"))
@@ -336,9 +429,10 @@ def plan_program(lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags,
     """Phase 1: raw key columns → plan (counts + match arrays), one
     compiled program. Only counts2 crosses to the host; the match arrays
     stay on device for phase 2."""
-    gl, gr = _keys_to_gids(lkeys, lkvalid, rkeys, rkvalid, str_flags)
-    return join_plan_gids(gl, gr, _vm(lemit, gl.shape[0]),
-                          _vm(remit, gr.shape[0]), join_type)
+    lbits, lkv, rbits, rkv = _keys_to_bits(lkeys, lkvalid, rkeys, rkvalid,
+                                           str_flags)
+    return join_plan_keys(lbits, lkv, _vm(lemit, lkv.shape[0]),
+                          rbits, rkv, _vm(remit, rkv.shape[0]), join_type)
 
 
 @partial(jax.jit, static_argnames=("join_type", "cap_p", "cap_u"))

@@ -87,19 +87,16 @@ def _all_valid(cols: Sequence[Column]) -> jnp.ndarray:
 # per-shard kernels (cached per mesh/static-shape signature)
 # ---------------------------------------------------------------------------
 
-# per-shard shared dense key ids with null sentinels
-_shard_gids = _join.compute_gids
-
-
 @lru_cache(maxsize=None)
 def _join_plan_fn(mesh, join_type: _join.JoinType):
-    """Per-shard join plan: one match sort per shard, counts + match
-    arrays stay sharded on device for the materialize phase."""
+    """Per-shard join plan: ONE fused sort per shard (join_plan_keys),
+    counts + match arrays stay sharded on device for the materialize
+    phase."""
     spec = P(mesh.axis_names[0])
 
     def kernel(lbits, lkv, lemit, rbits, rkv, remit):
-        gl, gr = _shard_gids(lbits, lkv, rbits, rkv)
-        return _join.join_plan_gids(gl, gr, lemit, remit, join_type)
+        return _join.join_plan_keys(lbits, lkv, lemit, rbits, rkv, remit,
+                                    join_type)
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
                              out_specs=spec))
