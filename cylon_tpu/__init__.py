@@ -21,6 +21,9 @@ from .io.csv import read_csv, write_csv
 from .io.parquet import read_parquet, write_parquet
 from .ops.groupby import AggregationOp
 from .ops.join import JoinAlgorithm, JoinConfig, JoinType
+from .parallel.dist_ops import (distributed_groupby, distributed_join,
+                                distributed_set_op, distributed_sort,
+                                hash_partition, repartition, shuffle)
 from .status import Code, CylonError, Status
 
 __version__ = "0.1.0"
@@ -30,7 +33,9 @@ __all__ = [
     "CSVReadOptions", "CSVWriteOptions", "CylonContext", "CylonError",
     "DataType", "JoinAlgorithm", "JoinConfig", "JoinType", "Layout",
     "LocalConfig", "MPIConfig", "MultiHostConfig", "ParquetOptions", "Row",
-    "Status", "TPUConfig", "Table", "Type", "concat_tables", "join",
-    "read_csv", "read_parquet", "set_op", "telemetry", "write_csv",
-    "write_parquet",
+    "Status", "TPUConfig", "Table", "Type", "concat_tables",
+    "distributed_groupby", "distributed_join", "distributed_set_op",
+    "distributed_sort", "hash_partition", "join", "read_csv",
+    "read_parquet", "repartition", "set_op", "shuffle", "telemetry",
+    "write_csv", "write_parquet",
 ]

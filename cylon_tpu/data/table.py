@@ -536,6 +536,7 @@ def _as_agg_op(o) -> _groupby.AggregationOp:
     return _groupby.AggregationOp(int(o))
 
 
+from ..util import capacity as _capacity
 from ..util import pow2 as _pow2  # shared capacity-rounding policy
 
 
@@ -632,8 +633,8 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
             lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags,
             config.type)
         n_primary, n_un = (int(v) for v in jax.device_get(counts2))
-    cap_p = _pow2(n_primary)
-    cap_u = _pow2(n_un) if config.type == _join.JoinType.FULL_OUTER else 0
+    cap_p = _capacity(n_primary)
+    cap_u = _capacity(n_un) if config.type == _join.JoinType.FULL_OUTER else 0
     aemit = remit if config.type == _join.JoinType.RIGHT else lemit
 
     ldat = tuple(c.data for c in left._columns)

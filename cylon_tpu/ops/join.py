@@ -112,24 +112,6 @@ def _as_list(v):
 # Everything below is sorts, cumsums, gathers and unique-index scatters.
 # ---------------------------------------------------------------------------
 
-LEFT_NULL_GID = np.int32(-1)
-RIGHT_NULL_GID = np.int32(-2)
-# Emit-mask sentinels are DISTINCT from the null sentinels: the plan runs
-# with sides swapped for RIGHT joins, so a masked
-# first-arg row re-tagged with LEFT_NULL_GID would collide with a null-key
-# row of the true left table (already −1 from compute_gids). −3/−4 can
-# never equal a real gid (≥0) or a null sentinel on either side.
-_MASKED_A_GID = np.int32(-3)
-_MASKED_B_GID = np.int32(-4)
-
-
-def _mask_gids(ga, gb, aemit, bemit):
-    """Non-emitted rows (padding, filtered) must not act as match PARTNERS
-    either — give them positional sentinels that match nothing."""
-    return (jnp.where(aemit, ga, _MASKED_A_GID),
-            jnp.where(bemit, gb, _MASKED_B_GID))
-
-
 def _match_lo_m(ga, gb) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-a-row match info against b: lo[i] = #b-rows with gid < ga[i]
     (= start of the equal-gid run in gid-sorted b order), m[i] = #b-rows
@@ -346,8 +328,15 @@ def _expand_from_match(lo, m, aemit, bperm, out_size: int,
     total = off[-1]
     starts = off - mm
     # bpos = lo[i] + (j - starts[i]) = j + delta[i]; two's-complement
-    # arithmetic keeps (x*2+bit)>>1 == x for negative deltas
-    delta2 = (lo - starts) * 2 + (m > 0)
+    # arithmetic keeps (x*2+bit)>>1 == x for negative deltas. The *2
+    # packing halves the int32 range, so past 2^30 output rows fall back
+    # to separate (delta, has) gathers instead of silently wrapping.
+    pack_ok = out_size < (1 << 30) and nb < (1 << 30)
+    if pack_ok:
+        delta2 = (lo - starts) * 2 + (m > 0)
+    else:
+        delta = lo - starts
+        has_m = m > 0
 
     aiota = jnp.arange(na, dtype=jnp.int32)
     erank = jnp.cumsum((mm > 0).astype(jnp.int32))  # inclusive
@@ -359,12 +348,17 @@ def _expand_from_match(lo, m, aemit, bperm, out_size: int,
     i = jnp.take(emit_list, jnp.maximum(c - 1, 0), mode="clip")
 
     j = jnp.arange(out_size, dtype=jnp.int32)
-    d2 = jnp.take(delta2, i)
-    has = (d2 & 1) == 1
+    if pack_ok:
+        d2 = jnp.take(delta2, i)
+        has = (d2 & 1) == 1
+        d = d2 >> 1
+    else:
+        d = jnp.take(delta, i)
+        has = jnp.take(has_m, i)
     if nb == 0:
         bidx = jnp.full(out_size, -1, jnp.int32)
     else:
-        bpos = j + (d2 >> 1)
+        bpos = j + d
         bidx = jnp.take(bperm, bpos, mode="fill", fill_value=0)
         bidx = jnp.where(has, bidx, -1)
     valid = j < total
@@ -394,16 +388,6 @@ def join_materialize_gids(lo, m, bperm, un_mask, aemit,
 def _vm(v, n):
     """validity-or-None → mask (None means all-valid; stays device-side)."""
     return jnp.ones(n, dtype=bool) if v is None else v
-
-
-def compute_gids(lbits, lkv, rbits, rkv):
-    """Shared dense key ids with null sentinels (traceable; shared by the
-    fused local programs and the per-shard distributed kernels)."""
-    from .order import dense_ranks_two
-
-    gl, gr = dense_ranks_two(list(lbits), list(rbits))
-    return (jnp.where(lkv, gl, LEFT_NULL_GID),
-            jnp.where(rkv, gr, RIGHT_NULL_GID))
 
 
 def _keys_to_bits(lkeys, lkvalid, rkeys, rkvalid, str_flags):

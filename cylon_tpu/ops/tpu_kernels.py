@@ -1,0 +1,340 @@
+"""Pallas TPU kernel library — streaming relational primitives.
+
+XLA's gather/scatter on TPU costs ~15-30 ns/element regardless of index
+locality (measured on v5e: 33M-element random gather 509 ms, *sorted*
+gather 963 ms, scatter 250 ms — vs 96-192 ms for a full multi-operand
+sort, ~17 ms for a cumsum and ~10 ms for an elementwise pass). The
+relational hot paths are therefore rebuilt as streaming Pallas kernels
+that touch HBM sequentially and resolve indirection on-chip:
+
+- ``sweep_gather``  — in-kernel VMEM window gather out[i] = win[o[i]]:
+  sublane sweep of native (rows,128) lane gathers (`take_along_axis`
+  along lanes is a Mosaic primitive; wider windows sweep row-by-row
+  with compare+select).
+- ``block_cumsum``  — in-kernel flat inclusive scan of a (R,128) block
+  (`jnp.cumsum` has no Mosaic lowering).
+- ``inverse_monotone`` — o[q] = #{j : P[j] <= q} for a non-decreasing
+  block P: binary search over sweep_gather probes.
+- ``stream_compact`` — compact masked elements of K parallel u32 streams
+  into dense prefixes, writing element-exact output via row-aligned DMA
+  with a write pointer and partial-row tail carried in SMEM/VMEM across
+  the (sequential) TPU grid.
+
+Storage convention: 1-D streams are reshaped (n/128, 128) so windows can
+be DMA'd at dynamic *row* offsets (Mosaic rejects arbitrary-offset 1-D
+HBM slices; row-granular 2-D slices work).
+
+These replace the reference's builder-append materialization (reference:
+cpp/src/cylon/join/join_utils.cpp:131-196 `build_final_table`,
+cpp/src/cylon/util/copy_arrray.cpp `copy_array_by_indices`) with
+TPU-streaming equivalents. Off-TPU every wrapper accepts
+``interpret=True`` and runs under the Pallas interpreter (used by the
+CPU test suite; the XLA kernels in ops/join.py remain the portable
+default path).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+_I32MAX = jnp.iinfo(jnp.int32).max
+
+# Set while building a kernel for the interpreter so in-kernel helpers
+# avoid Mosaic-only primitives (pltpu.roll).
+_INTERPRET = [False]
+
+
+def _roll(x, k, axis):
+    if _INTERPRET[0]:
+        return jnp.roll(x, k, axis)
+    return pltpu.roll(x, k, axis)
+
+
+def rows_for(n: int) -> int:
+    return max(-(-n // LANES), 1)
+
+
+def pad_rows(x: jnp.ndarray, rows: int, fill=0) -> jnp.ndarray:
+    """1-D (n,) -> (rows, 128), zero/fill-padded."""
+    n = x.shape[0]
+    pad = rows * LANES - n
+    if pad:
+        x = jnp.concatenate([x, jnp.full(pad, fill, x.dtype)])
+    return x.reshape(rows, LANES)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel building blocks (pure functions of VMEM values)
+# ---------------------------------------------------------------------------
+
+
+def flat_iota(shape) -> jnp.ndarray:
+    return (jax.lax.broadcasted_iota(jnp.int32, shape, 0) * LANES
+            + jax.lax.broadcasted_iota(jnp.int32, shape, 1))
+
+
+def block_cumsum(x: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive scan of a (R,128) int32 block in flat row-major order."""
+    R = x.shape[0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    v = x
+    k = 1
+    while k < LANES:
+        v = v + jnp.where(lane >= k, _roll(v, k, 1), 0)
+        k <<= 1
+    if R == 1:
+        return v
+    tot = jnp.broadcast_to(v[:, LANES - 1:LANES], (R, LANES))
+    riota = jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
+    inc = tot
+    k = 1
+    while k < R:
+        inc = inc + jnp.where(riota >= k, _roll(inc, k, 0), 0)
+        k <<= 1
+    return v + (inc - tot)
+
+
+def flat_shift(x: jnp.ndarray, s, fill=0) -> jnp.ndarray:
+    """Shift a (R,128) block DOWN by s (dynamic, 0 <= s < 128) in flat
+    order; vacated head gets `fill`. Elements pushed past the end are
+    dropped (callers append a spill row first if they need them)."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    ra = _dyn_roll_lanes(x, s)
+    rb = _roll_rows1(ra)
+    shifted = jnp.where(lane >= s, ra, rb)
+    fi = flat_iota(x.shape)
+    return jnp.where(fi >= s, shifted, fill)
+
+
+def _dyn_roll_lanes(x, s):
+    """Roll lanes by dynamic s using take_along_axis (Mosaic-native)."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    src = (lane - s) % LANES
+    return jnp.take_along_axis(x, src, axis=1)
+
+
+def _roll_rows1(x):
+    """Roll rows down by one (row r takes row r-1; row 0 wraps)."""
+    return _roll(x, 1, 0)
+
+
+def flat_shift_up(x: jnp.ndarray, k: int, fill=0) -> jnp.ndarray:
+    """Shift a (R,128) block UP (toward index 0) by static k in flat
+    order; vacated tail gets `fill`."""
+    R = x.shape[0]
+    span = R * LANES
+    rows_k, q = k // LANES, k % LANES
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    a = _roll(x, (R - rows_k) % R, 0)  # pltpu.roll needs shift >= 0
+    if q == 0:
+        shifted = a
+    else:
+        b = _roll(x, (R - rows_k - 1) % R, 0)
+        ra = _roll(a, LANES - q, 1)
+        rb = _roll(b, LANES - q, 1)
+        shifted = jnp.where(lane < LANES - q, ra, rb)
+    fi = flat_iota(x.shape)
+    return jnp.where(fi < span - k, shifted, fill)
+
+
+def sweep_gather(win: jnp.ndarray, o: jnp.ndarray, fill=0) -> jnp.ndarray:
+    """out[i] = win.flat[o[i]] for window (W,128) and flat offsets o
+    (B,128); offsets outside [0, W*128) yield `fill`. Cost O(W) vops."""
+    W = win.shape[0]
+    orow = o // LANES
+    olane = jnp.where((o >= 0) & (orow < W), o % LANES, 0)
+    out = jnp.full(o.shape, fill, win.dtype)
+    for r in range(W):
+        bc = jnp.broadcast_to(win[r:r + 1, :], o.shape)
+        g = jnp.take_along_axis(bc, olane, axis=1)
+        out = jnp.where(orow == r, g, out)
+    return out
+
+
+def inverse_monotone(P: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """o[·] = #{j : P.flat[j] <= q[·]} for non-decreasing (R,128) P.
+    Binary search; q any int32 block shape."""
+    span = P.shape[0] * LANES
+    width = 1
+    while width < span:
+        width <<= 1
+    lo = jnp.zeros(q.shape, jnp.int32)
+    step = width
+    while step:
+        mid = lo + step
+        pv = sweep_gather(P, jnp.minimum(mid, span) - 1, fill=_I32MAX)
+        pv = jnp.where(mid <= span, pv, _I32MAX)
+        lo = jnp.where(pv <= q, mid, lo)
+        step >>= 1
+    return lo
+
+
+# ---------------------------------------------------------------------------
+# stream_compact
+# ---------------------------------------------------------------------------
+
+
+def stream_compact(mask: jnp.ndarray, streams: Sequence[jnp.ndarray],
+                   block_rows: int = 32, interpret: bool = False
+                   ) -> Tuple[Tuple[jnp.ndarray, ...], jnp.ndarray]:
+    """Compact ``streams[k][mask]`` into dense zero-padded prefixes.
+
+    mask: (n,) bool/int; streams: 1-D 32-bit arrays of length n.
+    Returns (tuple of compacted (n_pad,) arrays, count int32). n_pad =
+    n rounded up to a block multiple (tail beyond `count` is zeros).
+    """
+    nstreams = len(streams)
+    n = mask.shape[0]
+    BR = block_rows
+    # DMA windows must cover whole (8,128) sublane tiles — a copy of a
+    # non-multiple-of-8 row count hard-faults the chip (observed on v5e)
+    assert BR % 8 == 0 and BR >= 8
+    blocks = max(-(-n // (BR * LANES)), 1)
+    rows = blocks * BR
+    m2 = pad_rows(mask.astype(jnp.int32), rows)
+    s2 = [pad_rows(s.astype(jnp.uint32) if s.dtype != jnp.uint32 else s,
+                   rows) for s in streams]
+
+    _INTERPRET[0] = interpret
+    out_rows = rows + BR + 8  # dynamic write window may extend past rows
+
+    scratch = ([pltpu.SMEM((1,), jnp.int32),
+                pltpu.VMEM((nstreams, LANES), jnp.uint32)]
+               + [pltpu.VMEM((BR + 8, LANES), jnp.uint32)
+                  for _ in range(nstreams)]
+               + [pltpu.SemaphoreType.DMA((nstreams,))])
+
+    out_shapes = ([jax.ShapeDtypeStruct((out_rows, LANES), jnp.uint32)
+                   for _ in range(nstreams)]
+                  + [jax.ShapeDtypeStruct((1,), jnp.int32)])
+
+    def kernel(mask_ref, *rest):
+        srefs = rest[:nstreams]
+        outs = rest[nstreams:2 * nstreams]
+        cnt_ref = rest[2 * nstreams]
+        wptr = rest[2 * nstreams + 1]
+        tails = rest[2 * nstreams + 2]
+        bufs = list(rest[2 * nstreams + 3:2 * nstreams + 3 + nstreams])
+        sems = rest[2 * nstreams + 3 + nstreams]
+        _compact_streams(nstreams, BR, mask_ref, srefs, outs, cnt_ref,
+                         wptr, tails, bufs, sems)
+
+    try:
+        res = pl.pallas_call(
+            kernel,
+            out_shape=out_shapes,
+            grid=(blocks,),
+            in_specs=([pl.BlockSpec((BR, LANES), lambda i: (i, 0),
+                                    memory_space=pltpu.VMEM)] * (1 + nstreams)),
+            out_specs=([pl.BlockSpec(memory_space=pl.ANY)] * nstreams
+                       + [pl.BlockSpec(memory_space=pltpu.SMEM)]),
+            scratch_shapes=scratch,
+            compiler_params=pltpu.CompilerParams(has_side_effects=True),
+            interpret=interpret,
+        )(m2, *s2)
+    finally:
+        _INTERPRET[0] = False
+    outs, count = res[:nstreams], res[nstreams][0]
+    flat = tuple(
+        o.reshape(-1)[:rows * LANES].view(s.dtype)
+        if s.dtype != jnp.uint32 else o.reshape(-1)[:rows * LANES]
+        for o, s in zip(outs, streams))
+    return flat, count
+
+
+def _compact_streams(nstreams, BR, mask_ref, streams, out_refs, cnt_ref,
+                     wptr, tails, bufs, sems):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        wptr[0] = 0
+        for k in range(nstreams):
+            tails[k:k + 1, :] = jnp.zeros((1, LANES), jnp.uint32)
+
+    m = (mask_ref[:] != 0).astype(jnp.int32)
+    P = block_cumsum(m)
+    cnt = P[BR - 1, LANES - 1]
+    base = wptr[0]
+    s = base % LANES
+
+    # Staged-shift compaction: selected element at j must move UP by
+    # d[j] = #unselected before j (monotone non-decreasing). Moving by
+    # d's bits low-to-high is collision-free: for j1<j2 (both selected),
+    # (d2 mod 2^b) - (d1 mod 2^b) <= d2-d1 < j2-j1, so partial positions
+    # j - (d mod 2^b) stay strictly ordered. O(log span) cheap vector
+    # passes — no in-VMEM scatter, no O(rows) sweeps.
+    q = flat_iota((BR, LANES))
+    d = q + 1 - P          # unselected before j (exclusive, j selected)
+    pack = ((d.astype(jnp.uint32) << 1) | m.astype(jnp.uint32))
+    vals = [st[:] for st in streams]
+    span = BR * LANES
+    k = 1
+    b = 0
+    while k < span:
+        pa = flat_shift_up(pack, k, 0)
+        take = ((pa & 1) == 1) & (((pa >> 1) >> b) & 1 == 1)
+        keep = ((pack & 1) == 1) & (((pack >> 1) >> b) & 1 == 0)
+        pack = jnp.where(take, pa, jnp.where(keep, pack, jnp.uint32(0)))
+        vals = [jnp.where(take, flat_shift_up(v, k, 0),
+                          jnp.where(keep, v, jnp.uint32(0)))
+                for v in vals]
+        k <<= 1
+        b += 1
+
+    valid = q < cnt
+    lane1 = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    for k in range(nstreams):
+        v = jnp.where(valid, vals[k], jnp.uint32(0))
+        ext = jnp.concatenate([v, jnp.zeros((8, LANES), v.dtype)])
+        shifted = flat_shift(ext, s, 0)
+        first = jnp.where(lane1 < s, tails[k:k + 1, :], shifted[0:1, :])
+        blk = jnp.concatenate([first, shifted[1:]])
+        bufs[k][:] = blk
+        pltpu.make_async_copy(
+            bufs[k], out_refs[k].at[pl.ds(base // LANES, BR + 8)],
+            sems.at[k]).start()
+    newp = base + cnt
+    rel = newp // LANES - base // LANES
+    for k in range(nstreams):
+        pltpu.make_async_copy(
+            bufs[k], out_refs[k].at[pl.ds(base // LANES, BR + 8)],
+            sems.at[k]).wait()
+        tails[k:k + 1, :] = bufs[k][pl.ds(rel, 1), :]
+    wptr[0] = newp
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        cnt_ref[0] = newp
+        # The documented contract zero-pads the tail; real HBM outputs are
+        # not zero-initialized, so sweep zero windows over whatever lies
+        # beyond the final write window.
+        total_rows = pl.num_programs(0) * BR + BR + 8
+        start = base // LANES + BR + 8
+        nwin = (total_rows - start + (BR + 8) - 1) // (BR + 8)
+        for k in range(nstreams):
+            bufs[k][:] = jnp.zeros((BR + 8, LANES), jnp.uint32)
+
+        def zero_one(w, _):
+            for k in range(nstreams):
+                pltpu.make_async_copy(
+                    bufs[k],
+                    out_refs[k].at[pl.ds(jnp.minimum(
+                        start + w * (BR + 8),
+                        total_rows - (BR + 8)), BR + 8)],
+                    sems.at[k]).start()
+            for k in range(nstreams):
+                pltpu.make_async_copy(
+                    bufs[k],
+                    out_refs[k].at[pl.ds(jnp.minimum(
+                        start + w * (BR + 8),
+                        total_rows - (BR + 8)), BR + 8)],
+                    sems.at[k]).wait()
+            return _
+
+        jax.lax.fori_loop(0, nwin, zero_one, 0)

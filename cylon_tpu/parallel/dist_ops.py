@@ -48,7 +48,8 @@ from ..ops import setops as _setops
 from ..status import Code, CylonError
 from ..telemetry import phase as _phase
 from . import shard
-from .shuffle import exchange, _pow2
+from ..util import capacity as _capacity
+from .shuffle import exchange
 
 
 # ---------------------------------------------------------------------------
@@ -270,8 +271,8 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig
         # pair; capacity = pow2 of the worst shard (all shards share one
         # program)
         counts = np.asarray(jax.device_get(counts2)).reshape(world, 2)
-    cap_p = _pow2(int(counts[:, 0].max()))
-    cap_u = _pow2(int(counts[:, 1].max())) \
+    cap_p = _capacity(int(counts[:, 0].max()))
+    cap_u = _capacity(int(counts[:, 1].max())) \
         if jt == _join.JoinType.FULL_OUTER else 0
 
     with _phase("distributed_join.materialize", seq):
@@ -338,7 +339,7 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
         counts = np.asarray(jax.device_get(_setop_count_fn(ctx.mesh)(
             lkb, lemit, rkb, remit))).reshape(world, 3)
     total = counts[:, int(op)]
-    cap = _pow2(int(total.max()))
+    cap = _capacity(int(total.max()))
 
     with _phase("distributed_set_op.materialize", seq):
         od, ov, emit = _setop_mat_fn(ctx.mesh, op, cap)(

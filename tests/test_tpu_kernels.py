@@ -1,0 +1,123 @@
+"""Pallas streaming-kernel tests (interpreter mode — runs on the CPU
+test mesh; the same kernels compile to Mosaic on TPU, where bench.py and
+the TPU parity checks exercise them).
+
+Covers tpu_kernels.stream_compact (staged-shift compaction) and the
+in-kernel building blocks via small pallas_call probes.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cylon_tpu.ops import tpu_kernels as tk
+
+
+@pytest.mark.parametrize("n,br,ns,density", [
+    (1000, 8, 1, 0.4),
+    (5000, 8, 2, 0.9),
+    (16384, 8, 3, 0.5),
+    (40000, 16, 2, 0.03),
+    (4096, 8, 1, 0.0),
+    (4096, 8, 1, 1.0),
+])
+def test_stream_compact(n, br, ns, density):
+    rng = np.random.default_rng(7)
+    mask = rng.random(n) < density
+    streams = [rng.integers(0, 2 ** 32, n, dtype=np.uint64).astype(np.uint32)
+               for _ in range(ns)]
+    outs, cnt = tk.stream_compact(
+        jnp.asarray(mask), [jnp.asarray(s) for s in streams],
+        block_rows=br, interpret=True)
+    cnt = int(cnt)
+    assert cnt == mask.sum()
+    for o, s in zip(outs, streams):
+        np.testing.assert_array_equal(np.asarray(o)[:cnt], s[mask])
+        assert (np.asarray(o)[cnt:] == 0).all()
+
+
+def test_stream_compact_rejects_bad_block_rows():
+    with pytest.raises(AssertionError):
+        tk.stream_compact(jnp.ones(16, bool), [jnp.zeros(16, jnp.uint32)],
+                          block_rows=4, interpret=True)
+
+
+def _probe(body, out_shape, args):
+    """Run an in-kernel helper under the Pallas interpreter."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tk._INTERPRET[0] = True
+    try:
+        return pl.pallas_call(
+            body,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(args),
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=True,
+        )(*args)
+    finally:
+        tk._INTERPRET[0] = False
+
+
+def test_block_cumsum():
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 5, (16, 128)).astype(np.int32)
+
+    def body(x_ref, o_ref):
+        o_ref[:] = tk.block_cumsum(x_ref[:])
+
+    out = _probe(body, jax.ShapeDtypeStruct((16, 128), jnp.int32),
+                 [jnp.asarray(x)])
+    np.testing.assert_array_equal(
+        np.asarray(out).reshape(-1), np.cumsum(x.reshape(-1)))
+
+
+def test_sweep_gather():
+    rng = np.random.default_rng(1)
+    win = rng.integers(0, 2 ** 31, (8, 128)).astype(np.int32)
+    o = rng.integers(0, 8 * 128, (8, 128)).astype(np.int32)
+
+    def body(w_ref, o_ref, out_ref):
+        out_ref[:] = tk.sweep_gather(w_ref[:], o_ref[:])
+
+    out = _probe(body, jax.ShapeDtypeStruct((8, 128), jnp.int32),
+                 [jnp.asarray(win), jnp.asarray(o)])
+    np.testing.assert_array_equal(np.asarray(out),
+                                  win.reshape(-1)[o.reshape(-1)].reshape(8, 128))
+
+
+def test_inverse_monotone():
+    rng = np.random.default_rng(3)
+    P = np.cumsum(rng.integers(0, 2, (8, 128)).astype(np.int32).reshape(-1))
+    q = rng.integers(0, P[-1] + 2, (8, 128)).astype(np.int32)
+
+    def body(p_ref, q_ref, out_ref):
+        out_ref[:] = tk.inverse_monotone(p_ref[:], q_ref[:])
+
+    out = _probe(body, jax.ShapeDtypeStruct((8, 128), jnp.int32),
+                 [jnp.asarray(P.reshape(8, 128)), jnp.asarray(q)])
+    exp = np.searchsorted(P, q.reshape(-1), side="right").reshape(8, 128)
+    np.testing.assert_array_equal(np.asarray(out), exp)
+
+
+def test_flat_shift_updown():
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, 1000, (8, 128)).astype(np.int32)
+    flat = x.reshape(-1)
+
+    def body_dn(x_ref, o_ref):
+        o_ref[:] = tk.flat_shift(x_ref[:], jnp.int32(37), fill=0)
+
+    out = _probe(body_dn, jax.ShapeDtypeStruct((8, 128), jnp.int32),
+                 [jnp.asarray(x)])
+    exp = np.concatenate([np.zeros(37, np.int32), flat[:-37]])
+    np.testing.assert_array_equal(np.asarray(out).reshape(-1), exp)
+
+    def body_up(x_ref, o_ref):
+        o_ref[:] = tk.flat_shift_up(x_ref[:], 200, fill=0)
+
+    out = _probe(body_up, jax.ShapeDtypeStruct((8, 128), jnp.int32),
+                 [jnp.asarray(x)])
+    exp = np.concatenate([flat[200:], np.zeros(200, np.int32)])
+    np.testing.assert_array_equal(np.asarray(out).reshape(-1), exp)
