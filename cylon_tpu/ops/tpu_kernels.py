@@ -44,13 +44,10 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 _I32MAX = jnp.iinfo(jnp.int32).max
 
-# Set while building a kernel for the interpreter so in-kernel helpers
-# avoid Mosaic-only primitives (pltpu.roll).
-_INTERPRET = [False]
 
-
-def _roll(x, k, axis):
-    if _INTERPRET[0]:
+def _roll(x, k, axis, interpret=False):
+    # pltpu.roll is Mosaic-only; the interpreter needs jnp.roll
+    if interpret:
         return jnp.roll(x, k, axis)
     return pltpu.roll(x, k, axis)
 
@@ -78,14 +75,14 @@ def flat_iota(shape) -> jnp.ndarray:
             + jax.lax.broadcasted_iota(jnp.int32, shape, 1))
 
 
-def block_cumsum(x: jnp.ndarray) -> jnp.ndarray:
+def block_cumsum(x: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
     """Inclusive scan of a (R,128) int32 block in flat row-major order."""
     R = x.shape[0]
     lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     v = x
     k = 1
     while k < LANES:
-        v = v + jnp.where(lane >= k, _roll(v, k, 1), 0)
+        v = v + jnp.where(lane >= k, _roll(v, k, 1, interpret), 0)
         k <<= 1
     if R == 1:
         return v
@@ -94,18 +91,19 @@ def block_cumsum(x: jnp.ndarray) -> jnp.ndarray:
     inc = tot
     k = 1
     while k < R:
-        inc = inc + jnp.where(riota >= k, _roll(inc, k, 0), 0)
+        inc = inc + jnp.where(riota >= k, _roll(inc, k, 0, interpret), 0)
         k <<= 1
     return v + (inc - tot)
 
 
-def flat_shift(x: jnp.ndarray, s, fill=0) -> jnp.ndarray:
+def flat_shift(x: jnp.ndarray, s, fill=0, interpret: bool = False
+               ) -> jnp.ndarray:
     """Shift a (R,128) block DOWN by s (dynamic, 0 <= s < 128) in flat
     order; vacated head gets `fill`. Elements pushed past the end are
     dropped (callers append a spill row first if they need them)."""
     lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     ra = _dyn_roll_lanes(x, s)
-    rb = _roll_rows1(ra)
+    rb = _roll(ra, 1, 0, interpret)  # rows down by one
     shifted = jnp.where(lane >= s, ra, rb)
     fi = flat_iota(x.shape)
     return jnp.where(fi >= s, shifted, fill)
@@ -118,25 +116,21 @@ def _dyn_roll_lanes(x, s):
     return jnp.take_along_axis(x, src, axis=1)
 
 
-def _roll_rows1(x):
-    """Roll rows down by one (row r takes row r-1; row 0 wraps)."""
-    return _roll(x, 1, 0)
-
-
-def flat_shift_up(x: jnp.ndarray, k: int, fill=0) -> jnp.ndarray:
+def flat_shift_up(x: jnp.ndarray, k: int, fill=0, interpret: bool = False
+                  ) -> jnp.ndarray:
     """Shift a (R,128) block UP (toward index 0) by static k in flat
     order; vacated tail gets `fill`."""
     R = x.shape[0]
     span = R * LANES
     rows_k, q = k // LANES, k % LANES
     lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-    a = _roll(x, (R - rows_k) % R, 0)  # pltpu.roll needs shift >= 0
+    a = _roll(x, (R - rows_k) % R, 0, interpret)  # pltpu.roll: shift >= 0
     if q == 0:
         shifted = a
     else:
-        b = _roll(x, (R - rows_k - 1) % R, 0)
-        ra = _roll(a, LANES - q, 1)
-        rb = _roll(b, LANES - q, 1)
+        b = _roll(x, (R - rows_k - 1) % R, 0, interpret)
+        ra = _roll(a, LANES - q, 1, interpret)
+        rb = _roll(b, LANES - q, 1, interpret)
         shifted = jnp.where(lane < LANES - q, ra, rb)
     fi = flat_iota(x.shape)
     return jnp.where(fi < span - k, shifted, fill)
@@ -197,10 +191,14 @@ def stream_compact(mask: jnp.ndarray, streams: Sequence[jnp.ndarray],
     blocks = max(-(-n // (BR * LANES)), 1)
     rows = blocks * BR
     m2 = pad_rows(mask.astype(jnp.int32), rows)
-    s2 = [pad_rows(s.astype(jnp.uint32) if s.dtype != jnp.uint32 else s,
+    # BITCAST (not value-cast) to u32: the outputs are bit-reinterpreted
+    # back via .view(s.dtype), so the round trip must be bit-exact
+    for s in streams:
+        assert s.dtype.itemsize == 4, \
+            f"stream_compact takes 32-bit streams, got {s.dtype}"
+    s2 = [pad_rows(s if s.dtype == jnp.uint32 else s.view(jnp.uint32),
                    rows) for s in streams]
 
-    _INTERPRET[0] = interpret
     out_rows = rows + BR + 8  # dynamic write window may extend past rows
 
     scratch = ([pltpu.SMEM((1,), jnp.int32),
@@ -222,23 +220,20 @@ def stream_compact(mask: jnp.ndarray, streams: Sequence[jnp.ndarray],
         bufs = list(rest[2 * nstreams + 3:2 * nstreams + 3 + nstreams])
         sems = rest[2 * nstreams + 3 + nstreams]
         _compact_streams(nstreams, BR, mask_ref, srefs, outs, cnt_ref,
-                         wptr, tails, bufs, sems)
+                         wptr, tails, bufs, sems, interpret)
 
-    try:
-        res = pl.pallas_call(
-            kernel,
-            out_shape=out_shapes,
-            grid=(blocks,),
-            in_specs=([pl.BlockSpec((BR, LANES), lambda i: (i, 0),
-                                    memory_space=pltpu.VMEM)] * (1 + nstreams)),
-            out_specs=([pl.BlockSpec(memory_space=pl.ANY)] * nstreams
-                       + [pl.BlockSpec(memory_space=pltpu.SMEM)]),
-            scratch_shapes=scratch,
-            compiler_params=pltpu.CompilerParams(has_side_effects=True),
-            interpret=interpret,
-        )(m2, *s2)
-    finally:
-        _INTERPRET[0] = False
+    res = pl.pallas_call(
+        kernel,
+        out_shape=out_shapes,
+        grid=(blocks,),
+        in_specs=([pl.BlockSpec((BR, LANES), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)] * (1 + nstreams)),
+        out_specs=([pl.BlockSpec(memory_space=pl.ANY)] * nstreams
+                   + [pl.BlockSpec(memory_space=pltpu.SMEM)]),
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(m2, *s2)
     outs, count = res[:nstreams], res[nstreams][0]
     flat = tuple(
         o.reshape(-1)[:rows * LANES].view(s.dtype)
@@ -248,7 +243,7 @@ def stream_compact(mask: jnp.ndarray, streams: Sequence[jnp.ndarray],
 
 
 def _compact_streams(nstreams, BR, mask_ref, streams, out_refs, cnt_ref,
-                     wptr, tails, bufs, sems):
+                     wptr, tails, bufs, sems, interpret=False):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -258,7 +253,7 @@ def _compact_streams(nstreams, BR, mask_ref, streams, out_refs, cnt_ref,
             tails[k:k + 1, :] = jnp.zeros((1, LANES), jnp.uint32)
 
     m = (mask_ref[:] != 0).astype(jnp.int32)
-    P = block_cumsum(m)
+    P = block_cumsum(m, interpret)
     cnt = P[BR - 1, LANES - 1]
     base = wptr[0]
     s = base % LANES
@@ -277,11 +272,11 @@ def _compact_streams(nstreams, BR, mask_ref, streams, out_refs, cnt_ref,
     k = 1
     b = 0
     while k < span:
-        pa = flat_shift_up(pack, k, 0)
+        pa = flat_shift_up(pack, k, 0, interpret)
         take = ((pa & 1) == 1) & (((pa >> 1) >> b) & 1 == 1)
         keep = ((pack & 1) == 1) & (((pack >> 1) >> b) & 1 == 0)
         pack = jnp.where(take, pa, jnp.where(keep, pack, jnp.uint32(0)))
-        vals = [jnp.where(take, flat_shift_up(v, k, 0),
+        vals = [jnp.where(take, flat_shift_up(v, k, 0, interpret),
                           jnp.where(keep, v, jnp.uint32(0)))
                 for v in vals]
         k <<= 1
@@ -292,7 +287,7 @@ def _compact_streams(nstreams, BR, mask_ref, streams, out_refs, cnt_ref,
     for k in range(nstreams):
         v = jnp.where(valid, vals[k], jnp.uint32(0))
         ext = jnp.concatenate([v, jnp.zeros((8, LANES), v.dtype)])
-        shifted = flat_shift(ext, s, 0)
+        shifted = flat_shift(ext, s, 0, interpret)
         first = jnp.where(lane1 < s, tails[k:k + 1, :], shifted[0:1, :])
         blk = jnp.concatenate([first, shifted[1:]])
         bufs[k][:] = blk

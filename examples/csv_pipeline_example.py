@@ -1,0 +1,44 @@
+"""CSV in → relational pipeline → CSV out (reference:
+python/examples/table_relational_algebra.py and the per-rank CSV
+convention of cpp/test/join_test.cpp:22-24).
+
+Writes two CSVs, reads them back with options, joins, filters and
+groups, then writes the result.
+"""
+import os
+import tempfile
+
+import numpy as np
+
+import cylon_tpu as ct
+
+
+def main():
+    ctx = ct.CylonContext.Init()
+    rng = np.random.default_rng(1)
+    d = tempfile.mkdtemp()
+    orders_path = os.path.join(d, "orders.csv")
+    items_path = os.path.join(d, "items.csv")
+
+    ct.Table.from_pydict(ctx, {
+        "order_id": np.arange(1000, dtype=np.int64),
+        "customer": rng.integers(0, 100, 1000).astype(np.int64),
+    }).to_csv(orders_path)
+    ct.Table.from_pydict(ctx, {
+        "order_id": rng.integers(0, 1000, 5000).astype(np.int64),
+        "amount": rng.exponential(30.0, 5000),
+    }).to_csv(items_path)
+
+    opts = ct.CSVReadOptions().use_threads(True).block_size(1 << 20)
+    orders = ct.read_csv(ctx, orders_path, opts)
+    items = ct.read_csv(ctx, items_path, opts)
+
+    joined = orders.join(items, "inner", on="order_id")
+    by_customer = joined.groupby(1, [3], ["sum"])  # customer, sum(amount)
+    out_path = os.path.join(d, "spend.csv")
+    by_customer.sort(0).to_csv(out_path)
+    print("wrote", out_path, "rows:", by_customer.row_count)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Select / Project / sort / pandas-style masks (reference:
+cpp/src/examples/select_example.cpp, project_example.cpp, and the
+pycylon mask dunders in python/pycylon/data/table.pyx:749-798).
+"""
+import numpy as np
+
+import cylon_tpu as ct
+
+
+def main():
+    ctx = ct.CylonContext.Init()
+    rng = np.random.default_rng(5)
+    t = ct.Table.from_pydict(ctx, {
+        "a": rng.integers(0, 100, 1000).astype(np.int64),
+        "b": rng.normal(size=1000),
+        "c": rng.integers(0, 2, 1000).astype(np.int32),
+    })
+
+    # row-lambda select (reference row-loop style — use masks on hot paths)
+    small = t.select(lambda row: row.get_int64(0) < 10)
+    print("select a<10:", small.row_count)
+
+    # vectorized mask path (pandas-style)
+    hot = t[t["a"] > 90]
+    print("mask a>90:", hot.row_count)
+
+    proj = t.project(["a", "c"])
+    print("projected columns:", proj.column_names)
+
+    print("sorted by b (desc), first rows:")
+    t.sort("b", ascending=False).show(0, 3)
+
+
+if __name__ == "__main__":
+    main()

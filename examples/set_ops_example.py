@@ -1,0 +1,40 @@
+"""Union / intersect / subtract (reference: cpp/src/examples/
+union_example.cpp, intersect_example.cpp, subtract_example.cpp).
+
+Set ops are full-row distinct operations: union deduplicates the
+concatenation, intersect keeps distinct rows present in both, subtract
+keeps distinct left rows absent from the right.
+"""
+import numpy as np
+
+import cylon_tpu as ct
+
+
+def main():
+    import jax
+
+    distributed = len(jax.devices()) > 1
+    ctx = (ct.CylonContext.InitDistributed(ct.TPUConfig())
+           if distributed else ct.CylonContext.Init())
+
+    rng = np.random.default_rng(3)
+    a = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, 50, 200).astype(np.int32),
+        "g": rng.integers(0, 4, 200).astype(np.int32),
+    })
+    b = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(25, 75, 200).astype(np.int32),
+        "g": rng.integers(0, 4, 200).astype(np.int32),
+    })
+
+    if distributed:
+        u, i, s = (a.distributed_union(b), a.distributed_intersect(b),
+                   a.distributed_subtract(b))
+    else:
+        u, i, s = a.union(b), a.intersect(b), a.subtract(b)
+    print("union:", u.row_count, "intersect:", i.row_count,
+          "subtract:", s.row_count)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Data engineering for ML: cylon_tpu ETL → torch training (reference:
+cpp/src/tutorial/demo_pytorch.py and
+python/examples/cylon_sequential_mnist.py — pycylon ETL → to_numpy →
+torch tensors → model).
+
+The framework does the relational heavy lifting (join feature tables,
+filter, aggregate) on the TPU mesh; the trained framework gets dense
+numpy blocks. The reference's DDP/NCCL variant
+(demo_pytorch_distributed.py) maps to per-process shards here: each
+controller process feeds its own accelerator from its shard
+(ctx.get_rank() / per-process file placement, io/csv.py).
+"""
+import numpy as np
+
+import cylon_tpu as ct
+
+
+def make_features(ctx, n=20_000):
+    rng = np.random.default_rng(0)
+    users = ct.Table.from_pydict(ctx, {
+        "uid": np.arange(n, dtype=np.int64),
+        "age": rng.integers(18, 80, n).astype(np.float32),
+    })
+    events = ct.Table.from_pydict(ctx, {
+        "uid": rng.integers(0, n, 5 * n).astype(np.int64),
+        "spend": rng.exponential(20.0, 5 * n).astype(np.float32),
+    })
+    # label: did the user spend > 100 total
+    per_user = events.groupby(0, ["spend"], ["sum"])
+    table = users.join(per_user, "left", on="uid")
+    return table
+
+
+def main():
+    ctx = ct.CylonContext.Init()
+    table = make_features(ctx)
+
+    x = table.project([1, 3]).to_numpy(order="C").astype(np.float32)
+    x = np.nan_to_num(x)
+    y = (x[:, 1] > 100.0).astype(np.float32)
+    x[:, 1] = 0.0  # don't leak the label
+
+    try:
+        import torch
+    except ImportError:
+        print("torch not installed; ETL produced", x.shape, "features")
+        return
+
+    ds = torch.utils.data.TensorDataset(torch.from_numpy(x),
+                                        torch.from_numpy(y))
+    dl = torch.utils.data.DataLoader(ds, batch_size=256, shuffle=True)
+    model = torch.nn.Sequential(torch.nn.Linear(2, 16), torch.nn.ReLU(),
+                                torch.nn.Linear(16, 1))
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = torch.nn.BCEWithLogitsLoss()
+    for epoch in range(2):
+        total = 0.0
+        for xb, yb in dl:
+            opt.zero_grad()
+            loss = loss_fn(model(xb).squeeze(-1), yb)
+            loss.backward()
+            opt.step()
+            total += float(loss.detach()) * len(xb)
+        print(f"epoch {epoch}: loss {total / len(ds):.4f}")
+
+
+if __name__ == "__main__":
+    main()

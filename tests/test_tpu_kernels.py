@@ -1,6 +1,6 @@
 """Pallas streaming-kernel tests (interpreter mode — runs on the CPU
-test mesh; the same kernels compile to Mosaic on TPU, where bench.py and
-the TPU parity checks exercise them).
+test mesh; the same kernels compile to Mosaic when invoked with
+``interpret=False`` on TPU hardware).
 
 Covers tpu_kernels.stream_compact (staged-shift compaction) and the
 in-kernel building blocks via small pallas_call probes.
@@ -36,10 +36,31 @@ def test_stream_compact(n, br, ns, density):
         assert (np.asarray(o)[cnt:] == 0).all()
 
 
+def test_stream_compact_float32_bit_exact():
+    # regression: inputs must be BITCAST to u32, not value-cast —
+    # a value cast turns 1.5 into u32 1 and the output view into 1e-45
+    rng = np.random.default_rng(9)
+    mask = rng.random(1000) < 0.5
+    vals = rng.normal(size=1000).astype(np.float32)
+    ints = rng.integers(-2**31, 2**31, 1000, dtype=np.int32)
+    (of, oi), cnt = tk.stream_compact(
+        jnp.asarray(mask), [jnp.asarray(vals), jnp.asarray(ints)],
+        interpret=True)
+    cnt = int(cnt)
+    np.testing.assert_array_equal(np.asarray(of)[:cnt], vals[mask])
+    np.testing.assert_array_equal(np.asarray(oi)[:cnt], ints[mask])
+
+
 def test_stream_compact_rejects_bad_block_rows():
     with pytest.raises(AssertionError):
         tk.stream_compact(jnp.ones(16, bool), [jnp.zeros(16, jnp.uint32)],
                           block_rows=4, interpret=True)
+
+
+def test_stream_compact_rejects_64bit_streams():
+    with pytest.raises(AssertionError):
+        tk.stream_compact(jnp.ones(16, bool), [jnp.zeros(16, jnp.float64)],
+                          block_rows=8, interpret=True)
 
 
 def _probe(body, out_shape, args):
@@ -47,17 +68,13 @@ def _probe(body, out_shape, args):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    tk._INTERPRET[0] = True
-    try:
-        return pl.pallas_call(
-            body,
-            out_shape=out_shape,
-            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(args),
-            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-            interpret=True,
-        )(*args)
-    finally:
-        tk._INTERPRET[0] = False
+    return pl.pallas_call(
+        body,
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(args),
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=True,
+    )(*args)
 
 
 def test_block_cumsum():
@@ -65,7 +82,7 @@ def test_block_cumsum():
     x = rng.integers(0, 5, (16, 128)).astype(np.int32)
 
     def body(x_ref, o_ref):
-        o_ref[:] = tk.block_cumsum(x_ref[:])
+        o_ref[:] = tk.block_cumsum(x_ref[:], interpret=True)
 
     out = _probe(body, jax.ShapeDtypeStruct((16, 128), jnp.int32),
                  [jnp.asarray(x)])
@@ -107,7 +124,8 @@ def test_flat_shift_updown():
     flat = x.reshape(-1)
 
     def body_dn(x_ref, o_ref):
-        o_ref[:] = tk.flat_shift(x_ref[:], jnp.int32(37), fill=0)
+        o_ref[:] = tk.flat_shift(x_ref[:], jnp.int32(37), fill=0,
+                                 interpret=True)
 
     out = _probe(body_dn, jax.ShapeDtypeStruct((8, 128), jnp.int32),
                  [jnp.asarray(x)])
@@ -115,7 +133,7 @@ def test_flat_shift_updown():
     np.testing.assert_array_equal(np.asarray(out).reshape(-1), exp)
 
     def body_up(x_ref, o_ref):
-        o_ref[:] = tk.flat_shift_up(x_ref[:], 200, fill=0)
+        o_ref[:] = tk.flat_shift_up(x_ref[:], 200, fill=0, interpret=True)
 
     out = _probe(body_up, jax.ShapeDtypeStruct((8, 128), jnp.int32),
                  [jnp.asarray(x)])
