@@ -1,12 +1,18 @@
-"""Benchmark driver — distributed inner join throughput on the attached
+"""Benchmark driver — the BASELINE.md tracked configs on the attached
 chip(s).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
+The primary metric is the distributed inner-join throughput; the rest of
+the tracked matrix (groupby-aggregate, global sort, set ops, TPC-H-Q5-style
+multi-join pipeline — BASELINE.md "Tracked configs") rides in
+detail.suite.
 
 Baseline: the reference's published single-worker distributed inner join —
 200M rows in 141.5 s ≈ 1.414M rows/s/worker (reference:
 docs/docs/arch.md:152, arXiv:2007.09589; see BASELINE.md). vs_baseline is
-our rows/sec/chip over that per-worker rate.
+our rows/sec/chip over that per-worker rate. The other configs have no
+published reference numbers (BASELINE.md:26-28) — their vs_baseline is
+null.
 """
 from __future__ import annotations
 
@@ -19,16 +25,32 @@ import numpy as np
 _BASELINE_ROWS_PER_S = 200e6 / 141.5
 
 
-def run(n_rows: int = 1 << 24, iters: int = 3) -> dict:
+def _time(fn, iters):
+    import jax
+
+    fn()  # warmup/compile
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def _mk_ctx():
     import jax
 
     import cylon_tpu as ct
 
-    n_dev = len(jax.devices())
-    if n_dev > 1:
-        ctx = ct.CylonContext.InitDistributed(ct.TPUConfig())
-    else:
-        ctx = ct.CylonContext.Init()
+    if len(jax.devices()) > 1:
+        return ct.CylonContext.InitDistributed(ct.TPUConfig())
+    return ct.CylonContext.Init()
+
+
+def bench_join(ctx, n_rows: int, iters: int) -> dict:
+    import jax
+
+    import cylon_tpu as ct
 
     rng = np.random.default_rng(0)
     left = ct.Table.from_pydict(ctx, {
@@ -40,36 +62,166 @@ def run(n_rows: int = 1 << 24, iters: int = 3) -> dict:
         "w": rng.normal(size=n_rows).astype(np.float32),
     })
 
+    out = {}
+
     def one_join():
         if ctx.is_distributed():
-            out = left.distributed_join(right, "inner", on="k")
+            t = left.distributed_join(right, "inner", on="k")
         else:
-            out = left.join(right, "inner", on="k")
-        jax.block_until_ready(out.get_column(0).data)
-        return out
+            t = left.join(right, "inner", on="k")
+        jax.block_until_ready(t.get_column(0).data)
+        out["t"] = t
 
-    one_join()  # warmup/compile
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        out = one_join()
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-
+    best = _time(one_join, iters)
     total_rows = 2 * n_rows  # rows ingested by the join (both sides)
-    rows_per_s_per_chip = total_rows / best / max(ctx.get_world_size(), 1)
+    world = max(ctx.get_world_size(), 1)
+    return {
+        "rows_per_s_per_chip": total_rows / best / world,
+        "wall_s_best": round(best, 4),
+        "out_rows": out["t"].row_count,
+    }
+
+
+def bench_groupby(ctx, n_rows: int, iters: int) -> dict:
+    import jax
+
+    import cylon_tpu as ct
+
+    rng = np.random.default_rng(1)
+    t = ct.Table.from_pydict(ctx, {
+        "g": rng.integers(0, 1 << 20, n_rows).astype(np.int32),
+        "x": rng.normal(size=n_rows).astype(np.float32),
+        "y": rng.integers(0, 100, n_rows).astype(np.int32),
+    })
+
+    def one():
+        g = t.groupby(0, [1, 2, 1], ["sum", "count", "mean"])
+        jax.block_until_ready(g.get_column(0).data)
+
+    best = _time(one, iters)
+    world = max(ctx.get_world_size(), 1)
+    return {"rows_per_s_per_chip": n_rows / best / world,
+            "wall_s_best": round(best, 4)}
+
+
+def bench_sort(ctx, n_rows: int, iters: int) -> dict:
+    import jax
+
+    import cylon_tpu as ct
+
+    rng = np.random.default_rng(2)
+    t = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, 1 << 31, n_rows).astype(np.int32),
+        "v": rng.normal(size=n_rows).astype(np.float32),
+    })
+
+    def one():
+        s = ct.distributed_sort(t, "k") if ctx.is_distributed() \
+            else t.sort("k")
+        jax.block_until_ready(s.get_column(0).data)
+
+    best = _time(one, iters)
+    world = max(ctx.get_world_size(), 1)
+    return {"rows_per_s_per_chip": n_rows / best / world,
+            "wall_s_best": round(best, 4)}
+
+
+def bench_setops(ctx, n_rows: int, iters: int) -> dict:
+    import jax
+
+    import cylon_tpu as ct
+
+    rng = np.random.default_rng(3)
+    a = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n_rows, n_rows).astype(np.int32),
+        "g": rng.integers(0, 1 << 20, n_rows).astype(np.int32),
+    })
+    b = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n_rows, n_rows).astype(np.int32),
+        "g": rng.integers(0, 1 << 20, n_rows).astype(np.int32),
+    })
+
+    def one():
+        u = a.distributed_union(b) if ctx.is_distributed() else a.union(b)
+        jax.block_until_ready(u.get_column(0).data)
+
+    best = _time(one, iters)
+    world = max(ctx.get_world_size(), 1)
+    return {"rows_per_s_per_chip": 2 * n_rows / best / world,
+            "wall_s_best": round(best, 4)}
+
+
+def bench_q5_pipeline(ctx, n_rows: int, iters: int) -> dict:
+    """TPC-H Q5 shape: 3-table star join + filter + grouped aggregate
+    (customer ⋈ orders ⋈ lineitem-ish, then revenue by group)."""
+    import jax
+
+    import cylon_tpu as ct
+
+    rng = np.random.default_rng(4)
+    n_cust = n_rows // 16
+    cust = ct.Table.from_pydict(ctx, {
+        "ck": np.arange(n_cust, dtype=np.int32),
+        "region": rng.integers(0, 5, n_cust).astype(np.int32),
+    })
+    orders = ct.Table.from_pydict(ctx, {
+        "ok": np.arange(n_rows // 4, dtype=np.int32),
+        "ck": rng.integers(0, n_cust, n_rows // 4).astype(np.int32),
+    })
+    items = ct.Table.from_pydict(ctx, {
+        "ok": rng.integers(0, n_rows // 4, n_rows).astype(np.int32),
+        "price": rng.exponential(100.0, n_rows).astype(np.float32),
+    })
+
+    dist = ctx.is_distributed()
+
+    def one():
+        co = cust.distributed_join(orders, "inner", left_on=["ck"],
+                                   right_on=["ck"]) if dist else \
+            cust.join(orders, "inner", left_on=["ck"], right_on=["ck"])
+        # co columns: [ck, region, ok, ck]; region filter: region < 2
+        full = co.filter_mask(co._columns[1].data < 2)
+        coi = full.distributed_join(items, "inner", left_on=[2],
+                                    right_on=[0]) if dist else \
+            full.join(items, "inner", left_on=[2], right_on=[0])
+        # group revenue by region (col 1), summing price (last col)
+        g = coi.groupby(1, [coi.column_count - 1], ["sum"])
+        jax.block_until_ready(g.get_column(0).data)
+
+    best = _time(one, iters)
+    world = max(ctx.get_world_size(), 1)
+    # rows ingested across the pipeline
+    total = n_cust + n_rows // 4 + n_rows
+    return {"rows_per_s_per_chip": total / best / world,
+            "wall_s_best": round(best, 4)}
+
+
+def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
+    import jax
+
+    ctx = _mk_ctx()
+    join_res = bench_join(ctx, n_rows, iters)
+    suite = {}
+    if full:
+        suite["groupby_agg"] = bench_groupby(ctx, n_rows, iters)
+        suite["global_sort"] = bench_sort(ctx, n_rows, iters)
+        suite["set_union"] = bench_setops(ctx, n_rows // 2, iters)
+        suite["q5_pipeline"] = bench_q5_pipeline(ctx, n_rows // 2, iters)
+    rps = join_res["rows_per_s_per_chip"]
     return {
         "metric": "dist_inner_join_rows_per_sec_per_chip",
-        "value": round(rows_per_s_per_chip, 1),
+        "value": round(rps, 1),
         "unit": "rows/s/chip",
-        "vs_baseline": round(rows_per_s_per_chip / _BASELINE_ROWS_PER_S, 3),
+        "vs_baseline": round(rps / _BASELINE_ROWS_PER_S, 3),
         "detail": {
             "n_rows_per_side": n_rows,
             "world": ctx.get_world_size(),
-            "wall_s_best": round(best, 4),
-            "wall_s_all": [round(t, 4) for t in times],
-            "out_rows": out.row_count,
+            "wall_s_best": join_res["wall_s_best"],
+            "out_rows": join_res["out_rows"],
             "backend": jax.devices()[0].platform,
+            "suite": {k: {kk: (round(vv, 1) if isinstance(vv, float) else vv)
+                          for kk, vv in v.items()}
+                      for k, v in suite.items()},
         },
     }
 
@@ -80,5 +232,6 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--rows", type=int, default=1 << 24)
     p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--join-only", action="store_true")
     a = p.parse_args()
-    print(json.dumps(run(a.rows, a.iters)))
+    print(json.dumps(run(a.rows, a.iters, full=not a.join_only)))

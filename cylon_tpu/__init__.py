@@ -17,7 +17,7 @@ from .data.column import Column
 from .data.row import Row
 from .data.table import Table, concat_tables, join, set_op
 from .dtypes import DataType, Layout, Type
-from .io.csv import read_csv, write_csv
+from .io.csv import read_csv, read_csv_per_rank, write_csv
 from .io.parquet import read_parquet, write_parquet
 from .ops.groupby import AggregationOp
 from .ops.join import JoinAlgorithm, JoinConfig, JoinType
@@ -36,6 +36,7 @@ __all__ = [
     "Status", "TPUConfig", "Table", "Type", "concat_tables",
     "distributed_groupby", "distributed_join", "distributed_set_op",
     "distributed_sort", "hash_partition", "join", "read_csv",
+    "read_csv_per_rank",
     "read_parquet", "repartition", "set_op", "shuffle", "telemetry",
     "write_csv", "write_parquet",
 ]

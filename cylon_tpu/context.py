@@ -22,12 +22,25 @@ import threading
 from typing import Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .config import CommConfig, CommType, LocalConfig, TPUConfig, MultiHostConfig
 from .status import Code, CylonError
 
 _AXIS = "p"  # the canonical 1-D mesh axis name for row partitioning
+
+
+def _distributed_initialized() -> bool:
+    """True when jax.distributed.initialize has already run (idempotence
+    guard that — unlike jax.process_count() — does not itself initialise
+    the XLA backend)."""
+    try:
+        from jax._src import distributed as _jd
+
+        return getattr(_jd.global_state, "client", None) is not None
+    except Exception:  # pragma: no cover - jax internals moved
+        return False
 
 
 class CylonContext:
@@ -52,7 +65,10 @@ class CylonContext:
 
         if ct == CommType.MULTIHOST:
             cfg: MultiHostConfig = config  # type: ignore[assignment]
-            if jax.process_count() == 1 and cfg.num_processes not in (None, 1):
+            if cfg.num_processes not in (None, 1) \
+                    and not _distributed_initialized():
+                # must run before ANY backend-initialising jax call
+                # (jax.process_count() itself would initialise it)
                 jax.distributed.initialize(
                     coordinator_address=cfg.coordinator_address,
                     num_processes=cfg.num_processes,
@@ -93,14 +109,34 @@ class CylonContext:
         return CylonContext(config or TPUConfig(), distributed=True)
 
     def get_world_size(self) -> int:
-        """Number of mesh devices (reference: GetWorldSize = MPI world size)."""
+        """Number of mesh devices (reference: GetWorldSize = MPI world size).
+
+        An MPI rank maps to a mesh SHARD here, so world = shard count, not
+        process count (one controller process drives many chips)."""
         return len(self.devices)
 
     def get_rank(self) -> int:
-        """Controller process index. In the reference every rank is a process;
-        here one controller drives all local chips, so `rank` is only
-        meaningful for multi-host file placement."""
+        """This controller's first shard index in the mesh (shard space —
+        consistent with `get_neighbours`). Single-controller meshes always
+        return 0; on multi-host meshes each process owns a contiguous run
+        of shards and `get_rank` is the first of them. For file placement
+        use `get_process_rank`/`local_shard_indices`."""
+        local = self.local_shard_indices()
+        return local[0] if local else 0
+
+    def get_process_rank(self) -> int:
+        """Controller process index (the reference's node-rank role for
+        per-rank file IO; reference: cpp/test/join_test.cpp:22-24)."""
         return jax.process_index()
+
+    def get_process_count(self) -> int:
+        return jax.process_count()
+
+    def local_shard_indices(self) -> List[int]:
+        """Shard indices whose device is addressable from this process."""
+        me = jax.process_index()
+        return [i for i, d in enumerate(self.devices)
+                if d.process_index == me]
 
     def get_neighbours(self, include_self: bool = False) -> List[int]:
         """All other shard indices, optionally including this controller's
@@ -117,11 +153,16 @@ class CylonContext:
             return self._sequence
 
     def barrier(self) -> None:
-        """Synchronize all devices (reference: MPI_Barrier)."""
+        """Synchronize all devices (reference: MPI_Barrier). Runs one tiny
+        SPMD program over the whole mesh — multi-host safe (a per-device
+        device_put would fail on non-addressable devices)."""
         if self._finalized:
             return
-        xs = [jax.device_put(np.zeros((), np.int32), d) for d in self.devices]
-        jax.block_until_ready([x + 1 for x in xs])
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        out = jax.jit(lambda: jnp.zeros((), jnp.int32) + 1,
+                      out_shardings=NamedSharding(self.mesh, P()))()
+        jax.block_until_ready(out)
 
     def finalize(self) -> None:
         self._finalized = True

@@ -77,6 +77,30 @@ def read_csv(ctx: CylonContext, path: Union[str, Sequence[str]],
     return _read_one(ctx, path, options)
 
 
+def read_csv_per_rank(ctx: CylonContext, path_pattern: str,
+                      options: Optional[CSVReadOptions] = None) -> Table:
+    """Per-rank file placement: ``path_pattern`` contains ``{rank}``,
+    substituted with each shard index (the reference's per-rank CSV
+    convention, cpp/test/join_test.cpp:22-24 ``csv1_<rank>.csv``).
+
+    Single-controller: reads EVERY shard's file and assembles them
+    shard-aligned (shard i of the result holds file i's rows). Multi-host:
+    each controller process reads only the files of the shards it owns —
+    collective, all processes must call it.
+    """
+    from ..parallel import shard as _shard
+
+    options = options or CSVReadOptions()
+    local = ctx.local_shard_indices()
+    paths = [path_pattern.format(rank=i) for i in local]
+    if options.IsConcurrentFileReads() and len(paths) > 1:
+        with ThreadPoolExecutor(max_workers=len(paths)) as ex:
+            tables = list(ex.map(lambda p: _read_one(ctx, p, options), paths))
+    else:
+        tables = [_read_one(ctx, p, options) for p in paths]
+    return _shard.assemble_process_local(tables, ctx)
+
+
 def _read_one(ctx: CylonContext, path: str, options: CSVReadOptions) -> Table:
     import pyarrow.csv as pacsv
 

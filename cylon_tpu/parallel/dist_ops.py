@@ -49,7 +49,7 @@ from ..status import Code, CylonError
 from ..telemetry import phase as _phase
 from . import shard
 from ..util import capacity as _capacity
-from .shuffle import exchange
+from .shuffle import exchange, replicated_gather
 
 
 # ---------------------------------------------------------------------------
@@ -90,17 +90,22 @@ def _all_valid(cols: Sequence[Column]) -> jnp.ndarray:
 
 @lru_cache(maxsize=None)
 def _join_plan_fn(mesh, join_type: _join.JoinType):
-    """Per-shard join plan: ONE fused sort per shard (join_plan_keys),
-    counts + match arrays stay sharded on device for the materialize
-    phase."""
-    spec = P(mesh.axis_names[0])
+    """Per-shard join plan: ONE fused sort per shard (join_plan_keys);
+    match arrays stay sharded on device for the materialize phase, the
+    [world, 2] count matrix is all_gather-REPLICATED so every controller
+    process can fetch it (multi-host safe)."""
+    axis = mesh.axis_names[0]
+    spec = P(axis)
 
     def kernel(lbits, lkv, lemit, rbits, rkv, remit):
-        return _join.join_plan_keys(lbits, lkv, lemit, rbits, rkv, remit,
-                                    join_type)
+        counts2, lo, m, bperm, un_mask = _join.join_plan_keys(
+            lbits, lkv, lemit, rbits, rkv, remit, join_type)
+        world = mesh.devices.size
+        return (replicated_gather(counts2, axis, world),
+                lo, m, bperm, un_mask)
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
-                             out_specs=spec))
+                             out_specs=(P(), spec, spec, spec, spec)))
 
 
 _gather_side = _join.gather_columns
@@ -128,11 +133,13 @@ def _setop_count_fn(mesh):
     def kernel(lbits, lemit, rbits, remit):
         gl, gr = _order.dense_ranks_two(list(lbits), list(rbits))
         c = _setops.setop_counts(gl, gr, lemit, remit)
-        return jnp.stack([c["n_union"], c["n_subtract"],
-                          c["n_intersect"]]).astype(jnp.int32)
+        counts = jnp.stack([c["n_union"], c["n_subtract"],
+                            c["n_intersect"]]).astype(jnp.int32)
+        return replicated_gather(counts, mesh.axis_names[0],
+                                 mesh.devices.size)
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 4,
-                             out_specs=spec))
+                             out_specs=P()))
 
 
 @lru_cache(maxsize=None)
@@ -267,9 +274,9 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig
         counts2, lo, m, bperm, un_mask = _join_plan_fn(ctx.mesh, jt)(
             lkb, lkv, lemit, rkb, rkv, remit)
         aemit = remit if jt == _join.JoinType.RIGHT else lemit
-        # counts2 concatenates each shard's [n_primary, n_unmatched_b]
-        # pair; capacity = pow2 of the worst shard (all shards share one
-        # program)
+        # counts2 is the replicated [world, 2] matrix of per-shard
+        # [n_primary, n_unmatched_b]; capacity = worst shard (all shards
+        # share one program)
         counts = np.asarray(jax.device_get(counts2)).reshape(world, 2)
     cap_p = _capacity(int(counts[:, 0].max()))
     cap_u = _capacity(int(counts[:, 1].max())) \

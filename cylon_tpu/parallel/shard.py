@@ -110,3 +110,81 @@ def distribute_array(arr, n_src_rows: int, ctx: CylonContext,
     cap = shard_capacity(n_src_rows, world)
     return jax.device_put(_pad_to(jnp.asarray(arr), world * cap, fill),
                           row_sharding(ctx))
+
+
+def assemble_process_local(tables, ctx: CylonContext) -> Table:
+    """Build ONE global distributed Table from per-shard host tables, one
+    per shard this process owns (the multi-host ingest path: the
+    reference's per-rank CSV convention, cpp/test/join_test.cpp:22-24,
+    maps to per-shard files read by the owning controller).
+
+    Every process calls this collectively with its own local shard list
+    (len == len(ctx.local_shard_indices())). Per-shard row counts may be
+    ragged; shards are padded to the global max (agreed via a tiny
+    all-gathered count exchange) and the padding is masked dead.
+
+    Limitation: dictionary-encoded (string) columns would need a global
+    vocabulary unification across processes; they are rejected here for
+    now.
+    """
+    from jax.experimental import multihost_utils
+
+    from ..status import Code, CylonError
+
+    local = ctx.local_shard_indices()
+    if len(tables) != len(local):
+        raise CylonError(
+            Code.Invalid,
+            f"need one table per local shard ({len(local)}), got {len(tables)}")
+    tables = [t.compact() for t in tables]
+    for t in tables:
+        for c in t._columns:
+            if c.dictionary is not None:
+                raise CylonError(
+                    Code.NotImplemented,
+                    "string columns need global vocab unification; "
+                    "multi-host ingest supports fixed-width columns only")
+
+    counts = np.array([t.capacity for t in tables], np.int64)
+    if ctx.get_process_count() > 1:
+        all_counts = np.asarray(
+            multihost_utils.process_allgather(counts)).reshape(-1)
+    else:
+        all_counts = counts
+    cap = -(-int(all_counts.max()) // _ROW_QUANTUM) * _ROW_QUANTUM
+    cap = max(cap, _ROW_QUANTUM)
+
+    sharding = row_sharding(ctx)
+    world = ctx.get_world_size()
+    first = tables[0]
+
+    def build(arrays, fill):
+        """Pad each local shard's array to [cap], stack, and lift to the
+        global [world*cap] array."""
+        blocks = []
+        for arr in arrays:
+            a = np.asarray(arr)
+            if a.shape[0] < cap:
+                pad = np.full((cap - a.shape[0],) + a.shape[1:], fill,
+                              a.dtype)
+                a = np.concatenate([a, pad])
+            blocks.append(a)
+        local_np = np.ascontiguousarray(np.concatenate(blocks))
+        if ctx.get_process_count() == 1:
+            return jax.device_put(jnp.asarray(local_np), sharding)
+        return jax.make_array_from_process_local_data(
+            sharding, local_np, (world * cap,) + local_np.shape[1:])
+
+    cols = []
+    for ci in range(first.column_count):
+        ref = first._columns[ci]
+        data = build([jax.device_get(t._columns[ci].data) for t in tables],
+                     0)
+        validity = None
+        if any(t._columns[ci].validity is not None for t in tables):
+            validity = build(
+                [jax.device_get(t._columns[ci].valid_mask())
+                 for t in tables], False)
+        cols.append(Column(data, ref.dtype, validity, None, ref.name))
+    emit = build([np.ones(t.capacity, np.bool_) for t in tables], False)
+    return Table(cols, ctx, emit)

@@ -57,9 +57,26 @@ from ..util import pow2 as _pow2
 MAX_BLOCK = 1 << 16
 
 
+def replicated_gather(x, axis: str, world: int):
+    """Per-shard [..] value → [world, ..] matrix REPLICATED on every shard.
+
+    psum of a one-hot row scatter rather than `all_gather`: shard_map's
+    varying-mesh-axes check can statically prove a psum result is
+    replicated (out_specs=P() legal), which it cannot for all_gather.
+    Replication matters on multi-host meshes — the host fetch of a
+    *sharded* count array would not be addressable from other controller
+    processes."""
+    row = jax.lax.axis_index(axis)
+    mat = jnp.zeros((world,) + x.shape, x.dtype).at[row].set(x)
+    return jax.lax.psum(mat, axis)
+
+
 @lru_cache(maxsize=None)
 def _count_fn(mesh):
-    """Per-shard send-count vector: counts[t] = live rows headed to shard t.
+    """Send-count matrix counts[s, t] = live rows shard s sends to shard t,
+    REPLICATED on every shard (an in-program all_gather) so the host fetch
+    is valid on every controller process — a sharded output would not be
+    addressable from the other hosts of a multi-host mesh.
 
     The moral equivalent of the reference's header phase
     (mpi_channel.cpp:211-225 sendHeader)."""
@@ -71,10 +88,10 @@ def _count_fn(mesh):
         t = jnp.where(emit, targets.astype(jnp.int32), world)
         counts = jax.ops.segment_sum(jnp.ones(t.shape[0], jnp.int32), t,
                                      num_segments=world + 1)
-        return counts[:world]
+        return replicated_gather(counts[:world], axis, world)
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec),
-                             out_specs=spec))
+                             out_specs=P()))
 
 
 @lru_cache(maxsize=None)
@@ -163,7 +180,7 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
     seq = ctx.get_next_sequence()
     with _phase("shuffle.count", seq):
         counts = np.asarray(jax.device_get(
-            _count_fn(ctx.mesh)(targets, emit))).reshape(world, world)
+            _count_fn(ctx.mesh)(targets, emit)))
     max_pair = int(counts.max()) if counts.size else 0
     recv_max = int(counts.sum(axis=0).max()) if counts.size else 0
     mb = max_block if max_block is not None else MAX_BLOCK

@@ -1,0 +1,101 @@
+"""Phase-level profile of the single-chip join at bench shapes, plus
+micro-benchmarks for the candidate optimizations (packed row gather vs
+per-column gathers, packed scatter)."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import cylon_tpu as ct
+from cylon_tpu.ops import join as _join
+
+
+def timeit(fn, *args, iters=3, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    n = 1 << 24
+    rng = np.random.default_rng(0)
+    lk = jnp.asarray(rng.integers(0, n, n).astype(np.int32))
+    lv = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    rk = jnp.asarray(rng.integers(0, n, n).astype(np.int32))
+    rv = jnp.asarray(rng.normal(size=n).astype(np.float32))
+
+    # --- phase 1: plan ---
+    none4 = (None,)
+    t_plan = timeit(lambda: _join.plan_program(
+        (lk,), none4, None, (rk,), none4, None, (False,),
+        _join.JoinType.INNER))
+    counts2, lo, m, bperm, un_mask = _join.plan_program(
+        (lk,), none4, None, (rk,), none4, None, (False,),
+        _join.JoinType.INNER)
+    n_p = int(jax.device_get(counts2)[0])
+    from cylon_tpu.util import capacity
+    cap = capacity(n_p)
+    print(f"plan: {t_plan*1e3:.1f} ms  n_primary={n_p} cap={cap}")
+
+    # --- phase 2: materialize ---
+    aemit = jnp.ones(n, bool)
+    t_mat = timeit(lambda: _join.materialize_program(
+        lo, m, bperm, un_mask, aemit,
+        (lk, lv), (None, None), (rk, rv), (None, None),
+        _join.JoinType.INNER, cap, 0))
+    print(f"materialize: {t_mat*1e3:.1f} ms")
+
+    # --- expansion alone (no payload gathers) ---
+    expand = jax.jit(lambda lo, m, bperm: _join._expand_from_match(
+        lo, m, aemit, bperm, cap, False))
+    t_exp = timeit(expand, lo, m, bperm)
+    print(f"  expand_from_match alone: {t_exp*1e3:.1f} ms")
+
+    # --- micro: gathers ---
+    idx = jnp.asarray(rng.integers(0, n, cap).astype(np.int32))
+    g1 = jax.jit(lambda d, i: jnp.take(d, i, axis=0))
+    t_g1 = timeit(g1, lk, idx)
+    print(f"micro 1-col gather [{cap}] from [{n}]: {t_g1*1e3:.1f} ms")
+
+    packed4 = jnp.stack([lk.view(jnp.uint32), lv.view(jnp.uint32),
+                         rk.view(jnp.uint32), rv.view(jnp.uint32)], axis=1)
+    t_g4 = timeit(g1, packed4, idx)
+    print(f"micro packed (n,4) row gather: {t_g4*1e3:.1f} ms "
+          f"(vs 4x1col = {4*t_g1*1e3:.1f} ms)")
+
+    packed2 = jnp.stack([lk.view(jnp.uint32), lv.view(jnp.uint32)], axis=1)
+    t_g2 = timeit(g1, packed2, idx)
+    print(f"micro packed (n,2) row gather: {t_g2*1e3:.1f} ms")
+
+    # --- micro: scatter packed vs separate ---
+    dest = jnp.asarray(rng.permutation(n).astype(np.int32))
+    s1 = jax.jit(lambda d, v: jnp.zeros(n, jnp.int32).at[d].set(v))
+    t_s1 = timeit(s1, dest, lo)
+    s2 = jax.jit(lambda d, a, b: jnp.zeros((n, 2), jnp.int32).at[d].set(
+        jnp.stack([a, b], axis=1)))
+    t_s2 = timeit(s2, dest, lo, m)
+    print(f"micro scatter 1col: {t_s1*1e3:.1f} ms  packed 2col: {t_s2*1e3:.1f} ms")
+
+    # --- micro: the fused plan sort ---
+    cls = jnp.zeros(2 * n, jnp.uint8)
+    bits = jnp.concatenate([lk.view(jnp.uint32), rk.view(jnp.uint32)])
+    side = jnp.concatenate([jnp.ones(n, jnp.uint8), jnp.zeros(n, jnp.uint8)])
+    iota = jnp.arange(2 * n, dtype=jnp.int32)
+    srt = jax.jit(lambda a, b, c, d: jax.lax.sort((a, b, c, d), num_keys=3))
+    t_sort = timeit(srt, cls, bits, side, iota)
+    print(f"micro fused 4-operand sort [{2*n}]: {t_sort*1e3:.1f} ms")
+
+    # cumsum micro
+    cs = jax.jit(lambda x: jnp.cumsum(x))
+    t_cs = timeit(cs, iota)
+    print(f"micro cumsum [{2*n}] i32: {t_cs*1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
