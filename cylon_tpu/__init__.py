@@ -22,8 +22,9 @@ from .io.parquet import read_parquet, write_parquet
 from .ops.groupby import AggregationOp
 from .ops.join import JoinAlgorithm, JoinConfig, JoinType
 from .parallel.dist_ops import (distributed_groupby, distributed_join,
-                                distributed_set_op, distributed_sort,
-                                hash_partition, repartition, shuffle)
+                                distributed_join_ring, distributed_set_op,
+                                distributed_sort, hash_partition,
+                                repartition, shuffle)
 from .status import Code, CylonError, Status
 
 __version__ = "0.1.0"
@@ -34,7 +35,8 @@ __all__ = [
     "DataType", "JoinAlgorithm", "JoinConfig", "JoinType", "Layout",
     "LocalConfig", "MPIConfig", "MultiHostConfig", "ParquetOptions", "Row",
     "Status", "TPUConfig", "Table", "Type", "concat_tables",
-    "distributed_groupby", "distributed_join", "distributed_set_op",
+    "distributed_groupby", "distributed_join", "distributed_join_ring",
+    "distributed_set_op",
     "distributed_sort", "hash_partition", "join", "read_csv",
     "read_csv_per_rank",
     "read_parquet", "repartition", "set_op", "shuffle", "telemetry",

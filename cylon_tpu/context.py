@@ -93,6 +93,12 @@ class CylonContext:
         self.devices: List = devices
         self.mesh = jax.sharding.Mesh(np.array(devices), (_AXIS,))
 
+        from .memory import MemoryPool
+
+        self.memory_pool = MemoryPool(
+            [d for d in devices
+             if d.process_index == jax.process_index()])
+
     # -- reference API (cylon_context.hpp) --
 
     @staticmethod

@@ -228,9 +228,20 @@ class Table:
         self._row_count_cache = None
 
     def retain_memory(self, retain: bool = True) -> None:
-        """Reference: Table::retainMemory (table.hpp:178) — a free-after-use
-        hint. JAX arrays are refcounted; accepted for API parity, no-op."""
-        del retain
+        """Reference: Table::retainMemory (table.hpp:178) — free-after-use
+        hint: with retain=False, the next operator that consumes this
+        table clears its column references after use (reference: Shuffle
+        frees non-retained inputs, table.cpp:207), letting the HBM return
+        to the arena as soon as XLA's refcounts drop."""
+        self._retain = bool(retain)
+
+    def is_retain(self) -> bool:
+        """Reference: Table::IsRetain (table.hpp:183)."""
+        return getattr(self, "_retain", True)
+
+    def _free_if_unretained(self) -> None:
+        if not self.is_retain():
+            self.clear()
 
     def finalize(self) -> None:
         self.clear()
@@ -319,9 +330,15 @@ class Table:
 
     def distributed_join(self, table: "Table", join_type: str = "inner",
                          algorithm: str = "sort", **kwargs) -> "Table":
+        """comm="shuffle" (default) repartitions both sides via all-to-all;
+        comm="ring" streams the build side around the mesh ring
+        (ArrowJoin-style overlap, best for a small build side)."""
         from ..parallel import dist_ops
 
+        comm = kwargs.pop("comm", "shuffle")
         cfg = self._make_join_config(table, join_type, algorithm, kwargs)
+        if comm == "ring":
+            return dist_ops.distributed_join_ring(self, table, cfg)
         return dist_ops.distributed_join(self, table, cfg)
 
     def _make_join_config(self, table: "Table", join_type, algorithm, kwargs
@@ -627,24 +644,45 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     rkvalid = tuple(c.validity for c in rcols)
     lemit, remit = left.row_mask, right.row_mask
 
-    seq = left._ctx.get_next_sequence()
-    with _telemetry.phase("join.plan", seq):
-        counts2, lo, m, bperm, un_mask = _join.plan_program(
-            lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags,
-            config.type)
-        n_primary, n_un = (int(v) for v in jax.device_get(counts2))
-    cap_p = _capacity(n_primary)
-    cap_u = _capacity(n_un) if config.type == _join.JoinType.FULL_OUTER else 0
-    aemit = remit if config.type == _join.JoinType.RIGHT else lemit
-
     ldat = tuple(c.data for c in left._columns)
     lval = tuple(c.validity for c in left._columns)
     rdat = tuple(c.data for c in right._columns)
     rval = tuple(c.validity for c in right._columns)
-    with _telemetry.phase("join.materialize", seq):
-        lod, lov, rod, rov, emit = _join.materialize_program(
-            lo, m, bperm, un_mask, aemit,
-            ldat, lval, rdat, rval, config.type, cap_p, cap_u)
+
+    seq = left._ctx.get_next_sequence()
+    use_stream = _join.stream_plan_applicable(lkeys, rkeys, str_flags,
+                                              config.type)
+    if use_stream:
+        interp = jax.default_backend() != "tpu"
+        with _telemetry.phase("join.plan", seq):
+            counts, elist, delc, startsc, blist = _join.plan_program_stream(
+                lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags,
+                config.type, interpret=interp)
+            n_primary = int(jax.device_get(counts)[0])
+        if n_primary < 0:
+            raise CylonError(Code.ExecutionError,
+                             "join output exceeds 2^31 rows per shard; "
+                             "repartition over more shards")
+        cap_p = _capacity(n_primary)
+        with _telemetry.phase("join.materialize", seq):
+            lod, lov, rod, rov, emit = _join.materialize_program_stream(
+                counts, elist, delc, startsc, blist,
+                ldat, lval, rdat, rval, config.type, cap_p)
+    else:
+        with _telemetry.phase("join.plan", seq):
+            counts2, lo, m, bperm, un_mask = _join.plan_program(
+                lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags,
+                config.type)
+            n_primary, n_un = (int(v) for v in jax.device_get(counts2))
+        cap_p = _capacity(n_primary)
+        cap_u = _capacity(n_un) \
+            if config.type == _join.JoinType.FULL_OUTER else 0
+        aemit = remit if config.type == _join.JoinType.RIGHT else lemit
+
+        with _telemetry.phase("join.materialize", seq):
+            lod, lov, rod, rov, emit = _join.materialize_program(
+                lo, m, bperm, un_mask, aemit,
+                ldat, lval, rdat, rval, config.type, cap_p, cap_u)
 
     nl = left.column_count
     cols = [Column(d, c.dtype, v, c.dictionary, f"lt-{i}")

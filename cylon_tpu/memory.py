@@ -1,0 +1,61 @@
+"""HBM accounting + comm-buffer budget.
+
+The reference's memory layer (reference: cpp/src/cylon/ctx/
+memory_pool.hpp:25-66 `MemoryPool`, arrow_memory_pool_utils.hpp:25-63
+`ProxyMemoryPool`/`ToArrowPool`) adapts a user pool into Arrow allocations.
+On TPU the allocator is the XLA runtime's HBM arena, so the pool's role
+becomes *accounting and budgeting*: report live/peak HBM per device and
+hand the shuffle a comm-buffer budget so blockwise exchange sizes its
+rounds to fit (the reference's analog: ArrowAllocator feeding receive
+buffers from the pool, arrow_all_to_all.cpp:234-247).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class MemoryPool:
+    """Per-context HBM accounting over the mesh's local devices.
+
+    ``comm_fraction`` bounds the portion of free HBM the shuffle may spend
+    on in-flight exchange buffers (see parallel/shuffle.exchange)."""
+
+    def __init__(self, devices, comm_fraction: float = 0.25):
+        self._devices = [d for d in devices
+                         if _stats(d) is not None]
+        self.comm_fraction = comm_fraction
+
+    def bytes_allocated(self) -> int:
+        """Live HBM across local mesh devices (0 when the backend does not
+        expose memory_stats, e.g. the CPU test platform)."""
+        return sum(_stats(d).get("bytes_in_use", 0)
+                   for d in self._devices)
+
+    def peak_bytes(self) -> int:
+        return sum(_stats(d).get("peak_bytes_in_use", 0)
+                   for d in self._devices)
+
+    def bytes_limit(self) -> int:
+        return sum(_stats(d).get("bytes_limit", 0) for d in self._devices)
+
+    def available_bytes(self) -> Optional[int]:
+        """Free HBM on the tightest local device; None when unknown."""
+        per = []
+        for d in self._devices:
+            s = _stats(d)
+            limit, used = s.get("bytes_limit"), s.get("bytes_in_use")
+            if limit:
+                per.append(limit - (used or 0))
+        return min(per) if per else None
+
+    def comm_budget_bytes(self) -> Optional[int]:
+        """Per-device byte budget for in-flight shuffle buffers."""
+        avail = self.available_bytes()
+        return None if avail is None else int(avail * self.comm_fraction)
+
+
+def _stats(device) -> Optional[Dict]:
+    try:
+        return device.memory_stats()
+    except Exception:
+        return None

@@ -401,6 +401,190 @@ def join_materialize_gids(lo, m, bperm, un_mask, aemit,
     return lidx, ridx, (lidx >= 0) | (ridx >= 0)
 
 
+# ---------------------------------------------------------------------------
+# Pallas streaming plan path. The XLA plan above spends ~2 s at 33M rows in
+# latency-bound scatter/gather passes (head broadcast + a/b-space
+# scatter-backs); the streaming kernel (ops/tpu_kernels.join_plan_stream)
+# fuses everything after the key sort into ONE sequential HBM pass and
+# emits the expansion plan directly in compacted form. Applicability:
+# single u32 key, INNER/LEFT/RIGHT (FULL_OUTER needs a backward pass —
+# falls back to the XLA plan), per-shard rows < 2^29.
+# ---------------------------------------------------------------------------
+
+# None = auto (TPU backend, or interpreter off-TPU when forced True);
+# False disables; True forces (tests force it with the interpreter).
+STREAM_PLAN: Optional[bool] = None
+
+
+def stream_plan_applicable(lkeys, rkeys, str_flags,
+                           join_type: JoinType) -> bool:
+    """Host-side check over key arrays (pre-ordered-bits): single 4-byte
+    (or dictionary-string) key, INNER/LEFT/RIGHT, both sides non-empty."""
+    if STREAM_PLAN is False or join_type == JoinType.FULL_OUTER:
+        return False
+    if len(lkeys) != 1:
+        return False
+
+    def width(x, is_str):
+        return 4 if is_str else np.dtype(x.dtype).itemsize
+
+    if width(lkeys[0], str_flags[0]) != 4 \
+            or width(rkeys[0], str_flags[0]) != 4 \
+            or (not str_flags[0] and lkeys[0].dtype == jnp.bool_):
+        return False
+    na, nb = lkeys[0].shape[0], rkeys[0].shape[0]
+    if na == 0 or nb == 0 or na + nb >= (1 << 29):
+        return False
+    if STREAM_PLAN:
+        return True
+    return jax.default_backend() == "tpu"
+
+
+@partial(jax.jit, static_argnames=("str_flags", "join_type", "interpret"))
+def plan_program_stream(lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
+                        str_flags, join_type: JoinType,
+                        interpret: bool = False):
+    """Phase 1 (stream path): raw key columns → sorted stream → Pallas
+    plan pass. Only counts[4] crosses to the host."""
+    from . import tpu_kernels as tk
+
+    lbits, lkv, rbits, rkv = _keys_to_bits(lkeys, lkvalid, rkeys, rkvalid,
+                                           str_flags)
+    lemit = _vm(lemit, lkv.shape[0])
+    remit = _vm(remit, rkv.shape[0])
+    if join_type == JoinType.RIGHT:
+        abits, akv, aemit = rbits, rkv, remit
+        bbits, bkv, bemit = lbits, lkv, lemit
+    else:
+        abits, akv, aemit = lbits, lkv, lemit
+        bbits, bkv, bemit = rbits, rkv, remit
+    na, nb = aemit.shape[0], bemit.shape[0]
+    n = na + nb
+
+    live = jnp.concatenate([aemit & akv, bemit & bkv])
+    emit = jnp.concatenate([aemit, bemit])
+    iota = jnp.arange(n, dtype=jnp.uint32)
+    tag = (jnp.concatenate([jnp.full(na, jnp.uint32(1 << 31)),
+                            jnp.zeros(nb, jnp.uint32)])
+           | (emit.astype(jnp.uint32) << 30)
+           | (live.astype(jnp.uint32) << 29) | iota)
+    bits = jnp.concatenate([abits[0], bbits[0]])
+    bits = jnp.where(live, bits, jnp.uint32(0xFFFFFFFF))
+    bits_s, tag_s = jax.lax.sort((bits, tag), num_keys=2)
+    return tk.join_plan_stream(bits_s, tag_s, na, nb,
+                               emit_unmatched_a=join_type != JoinType.INNER,
+                               interpret=interpret)
+
+
+def _pack_side(dat, val):
+    """Split a side's columns into u32-packable lanes (4-byte 1-D data +
+    validity widened to u32) and a fallback list of the rest.
+    Returns (lanes, lane_plan, fallback_idx): lane_plan[ci] = (data_lane,
+    validity_lane_or_None) for packed columns."""
+    lanes: list = []
+    lane_plan: dict = {}
+    fallback = []
+    for ci, (d, v) in enumerate(zip(dat, val)):
+        if d.ndim == 1 and d.shape[0] > 0 and d.dtype.itemsize == 4 \
+                and d.dtype != jnp.bool_:
+            dl = len(lanes)
+            lanes.append(d if d.dtype == jnp.uint32 else d.view(jnp.uint32))
+            vl = None
+            if v is not None:
+                vl = len(lanes)
+                lanes.append(v.astype(jnp.uint32))
+            lane_plan[ci] = (dl, vl)
+        else:
+            fallback.append(ci)
+    return lanes, lane_plan, fallback
+
+
+@partial(jax.jit, static_argnames=("join_type", "cap_p"))
+def materialize_program_stream(counts, elist, delc, startsc, blist,
+                               ldat, lval, rdat, rval,
+                               join_type: JoinType, cap_p: int):
+    """Phase 2 (stream path): compacted plan → payload. Returns
+    (ldat', lval', rdat', rval', emit).
+
+    The hot passes are output-sized (cap_p ≈ n_out rows), so the design
+    minimizes THEIR count: 4-byte payload columns are pre-gathered into
+    the plan's compacted orders (a-side by `elist` into run-ordinal
+    order, b-side by `blist` into key order — both ~input-sized packed
+    row gathers), after which the expansion needs only TWO output-sized
+    row gathers — the run-plan matrix at the covering ordinal and the
+    b-matrix at the monotone b-position — with payload lanes riding
+    along. Row indices (aidx/bidx) are materialized only for columns
+    that can't ride a u32 lane (8-byte, bool, empty)."""
+    n_out, n_emit = counts[0], counts[1]
+    na_pad = elist.shape[0]
+    el = jax.lax.bitcast_convert_type(elist, jnp.int32)
+    dc = jax.lax.bitcast_convert_type(delc, jnp.int32)
+    st = jax.lax.bitcast_convert_type(startsc, jnp.int32)
+    bl = jax.lax.bitcast_convert_type(blist, jnp.int32)
+
+    if join_type == JoinType.RIGHT:
+        adat, aval, bdat, bval = rdat, rval, ldat, lval
+    else:
+        adat, aval, bdat, bval = ldat, lval, rdat, rval
+
+    a_lanes, a_plan, a_fb = _pack_side(adat, aval)
+    b_lanes, b_plan, b_fb = _pack_side(bdat, bval)
+
+    # pre-gather packable payload into plan order (input-sized passes);
+    # matrices are PURE u32 — mixed-dtype stack would promote (to i64
+    # under x64) and break the 4-byte lane bitcasts
+    el_safe = jnp.maximum(el, 0)
+    bl_safe = jnp.maximum(bl, 0)
+    amat = jnp.stack(
+        [elist, delc] + [jnp.take(x, el_safe) for x in a_lanes], axis=1)
+    bmat = jnp.stack(
+        [blist] + [jnp.take(x, bl_safe) for x in b_lanes], axis=1)
+
+    # expansion: run-covering ordinal via unique-start scatter + cumsum
+    r = jnp.arange(na_pad, dtype=jnp.int32)
+    z = jnp.zeros(cap_p, jnp.int32).at[
+        jnp.where(r < n_emit, st, cap_p)].set(1, mode="drop")
+    c = jnp.cumsum(z)
+    ordx = jnp.maximum(c - 1, 0)
+    ga = jnp.take(amat, ordx, axis=0, mode="clip")   # output-sized pass 1
+    i = jax.lax.bitcast_convert_type(ga[:, 0], jnp.int32)
+    d2 = jax.lax.bitcast_convert_type(ga[:, 1], jnp.int32)
+    has = (d2 & 1) == 1
+    j = jnp.arange(cap_p, dtype=jnp.int32)
+    valid = j < n_out
+    bpos = jnp.clip(j + (d2 >> 1), 0, max(bl.shape[0] - 1, 0))
+    gb = jnp.take(bmat, bpos, axis=0, mode="clip")   # output-sized pass 2
+    bhit = has & valid
+
+    aidx = jnp.where(valid, i, -1)
+    bidx = jnp.where(bhit,
+                     jax.lax.bitcast_convert_type(gb[:, 0], jnp.int32), -1)
+
+    def unpack(dat, val, plan, fb, g, off, hit, idx):
+        od: list = [None] * len(dat)
+        ov: list = [None] * len(dat)
+        for ci, (dl, vl) in plan.items():
+            lane = g[:, off + dl]
+            od[ci] = jnp.where(hit, lane, 0) if dat[ci].dtype == jnp.uint32 \
+                else jnp.where(hit, lane, 0).view(dat[ci].dtype)
+            ov[ci] = hit if vl is None else ((g[:, off + vl] != 0) & hit)
+        if fb:
+            fbd, fbv = gather_columns(
+                tuple(dat[ci] for ci in fb), tuple(val[ci] for ci in fb),
+                idx)
+            for k, ci in enumerate(fb):
+                od[ci], ov[ci] = fbd[k], fbv[k]
+        return tuple(od), tuple(ov)
+
+    aod, aov = unpack(adat, aval, a_plan, a_fb, ga, 2, valid, aidx)
+    bod, bov = unpack(bdat, bval, b_plan, b_fb, gb, 1, bhit, bidx)
+    if join_type == JoinType.RIGHT:
+        lod, lov, rod, rov = bod, bov, aod, aov
+    else:
+        lod, lov, rod, rov = aod, aov, bod, bov
+    return lod, lov, rod, rov, valid
+
+
 def _vm(v, n):
     """validity-or-None → mask (None means all-valid; stays device-side)."""
     return jnp.ones(n, dtype=bool) if v is None else v
@@ -454,47 +638,20 @@ def gather_columns(dat, val, idx):
     gathered row AND a real (non-negative) index. Empty sources produce
     all-null outputs (idx is guaranteed all −1 then).
 
-    All 4-byte 1-D columns (and their validity masks, widened to u32) are
-    bit-packed into one (n, C) u32 matrix and fetched with ONE row gather:
-    random gathers on TPU are latency-bound (~15 ns/row regardless of row
-    width — measured (n,4) row gather 313 ms vs 4×258 ms separate at 17M
-    rows), so C columns ride one gather for the price of ~one."""
+    Columns are gathered individually: XLA fuses same-index gathers into
+    one HBM pass on its own, so manual (n, C) bit-packing only adds stack
+    copies (measured +200 ms at 17M rows — packing pays ONLY for gathers
+    with *independent* index vectors, as in _expand_from_match)."""
     safe = jnp.maximum(idx, 0)
     hit = idx >= 0
-    nc = len(dat)
-    out_d: list = [None] * nc
-    out_v: list = [None] * nc
-
-    lanes = []       # u32 views to pack
-    lane_tags = []   # ("d"|"v", column index)
-    for ci, (d, v) in enumerate(zip(dat, val)):
+    out_d, out_v = [], []
+    for d, v in zip(dat, val):
         if d.shape[0] == 0:
-            out_d[ci] = jnp.zeros(idx.shape + d.shape[1:], d.dtype)
-            out_v[ci] = jnp.zeros(idx.shape, bool)
-            continue
-        if d.ndim == 1 and d.dtype.itemsize == 4:
-            lanes.append(d if d.dtype == jnp.uint32 else d.view(jnp.uint32))
-            lane_tags.append(("d", ci))
-            if v is not None:
-                lanes.append(v.astype(jnp.uint32))
-                lane_tags.append(("v", ci))
-            else:
-                out_v[ci] = hit
+            out_d.append(jnp.zeros(idx.shape + d.shape[1:], d.dtype))
+            out_v.append(jnp.zeros(idx.shape, bool))
         else:
-            out_d[ci] = jnp.take(d, safe, axis=0)
-            out_v[ci] = hit if v is None else (jnp.take(v, safe) & hit)
-
-    if len(lanes) == 1:
-        g = jnp.take(lanes[0], safe)[:, None]
-    elif lanes:
-        g = jnp.take(jnp.stack(lanes, axis=1), safe, axis=0)
-    for li, (kind, ci) in enumerate(lane_tags):
-        col = g[:, li]
-        if kind == "d":
-            out_d[ci] = col if dat[ci].dtype == jnp.uint32 \
-                else col.view(dat[ci].dtype)
-        else:
-            out_v[ci] = (col != 0) & hit
+            out_d.append(jnp.take(d, safe, axis=0))
+            out_v.append(hit if v is None else (jnp.take(v, safe) & hit))
     return tuple(out_d), tuple(out_v)
 
 

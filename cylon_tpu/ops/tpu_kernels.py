@@ -96,6 +96,32 @@ def block_cumsum(x: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
     return v + (inc - tot)
 
 
+def block_cummax(x: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    """Inclusive running MAX of a (R,128) int32 block in flat row-major
+    order (same log-shift structure as block_cumsum)."""
+    R = x.shape[0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    neg = jnp.iinfo(x.dtype).min
+    v = x
+    k = 1
+    while k < LANES:
+        v = jnp.maximum(v, jnp.where(lane >= k, _roll(v, k, 1, interpret),
+                                     neg))
+        k <<= 1
+    if R == 1:
+        return v
+    tot = jnp.broadcast_to(v[:, LANES - 1:LANES], (R, LANES))
+    riota = jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
+    inc = tot
+    k = 1
+    while k < R:
+        inc = jnp.maximum(inc, jnp.where(riota >= k,
+                                         _roll(inc, k, 0, interpret), neg))
+        k <<= 1
+    prev_rows = jnp.where(riota > 0, _roll(inc, 1, 0, interpret), neg)
+    return jnp.maximum(v, prev_rows)
+
+
 def flat_shift(x: jnp.ndarray, s, fill=0, interpret: bool = False
                ) -> jnp.ndarray:
     """Shift a (R,128) block DOWN by s (dynamic, 0 <= s < 128) in flat
@@ -242,32 +268,175 @@ def stream_compact(mask: jnp.ndarray, streams: Sequence[jnp.ndarray],
     return flat, count
 
 
-def _compact_streams(nstreams, BR, mask_ref, streams, out_refs, cnt_ref,
-                     wptr, tails, bufs, sems, interpret=False):
-    i = pl.program_id(0)
+# ---------------------------------------------------------------------------
+# join_plan_stream — the streaming join planner
+# ---------------------------------------------------------------------------
 
-    @pl.when(i == 0)
-    def _():
-        wptr[0] = 0
-        for k in range(nstreams):
-            tails[k:k + 1, :] = jnp.zeros((1, LANES), jnp.uint32)
 
-    m = (mask_ref[:] != 0).astype(jnp.int32)
+def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
+                     nb: int, emit_unmatched_a: bool,
+                     block_rows: int = 64, interpret: bool = False):
+    """ONE sequential pass over the key-sorted row stream that computes the
+    whole join plan — the Pallas replacement for the XLA scatter/gather
+    chain in ops/join.join_plan_keys (profiled ~2 s of latency-bound
+    random HBM passes at 33M rows; this pass is bandwidth-bound streaming).
+
+    Inputs (key-sorted together, see ops/join.plan_program_stream):
+      bits_s: u32 order-normalized key bits; dead rows forced to ~0.
+      tag_s:  u32 ``side<<31 | emit<<30 | live<<29 | iota`` — probe (a)
+              rows carry side=1 and sort after build (b) rows within a run.
+
+    Per element the pass derives, with SMEM carries across the sequential
+    grid: the live-b prefix count (block_cumsum), run boundaries (shifted
+    compare), the run-head live-b prefix via a running MAX broadcast
+    (head values are non-decreasing in key order, so cummax IS the
+    broadcast — no scatter), match count m, output offsets (cumsum of
+    per-row multiplicity), and stream-compacts two groups:
+      group A (emitting probe rows): {orig index, packed delta2,
+              output start} — the expansion plan;
+      group B (live build rows):     {orig index} — the key-ordered build
+              permutation (bperm analog).
+
+    Returns (counts i32[4] = [n_out, n_emit, n_blive, 0], elist u32,
+    delc u32 (bitcast int32 delta2), startsc u32, blist u32); compacted
+    outputs are padded, entries beyond their count are garbage —
+    consumers mask by the counts (join_materialize_compact).
+    """
+    n = bits_s.shape[0]
+    BR = block_rows
+    assert BR % 8 == 0 and BR >= 8
+    assert n < (1 << 29)
+    blocks = max(-(-n // (BR * LANES)), 1)
+    rows = blocks * BR
+    allones = jnp.uint32(0xFFFFFFFF)
+    b2 = pad_rows(bits_s, rows, fill=allones)
+    t2 = pad_rows(tag_s, rows, fill=0)  # side=0, live=0 → inert
+
+    rows_a = rows_for(max(na, 1))
+    rows_b = rows_for(max(nb, 1))
+    out_rows_a = rows_a + BR + 8
+    out_rows_b = rows_b + BR + 8
+
+    out_shapes = (
+        [jax.ShapeDtypeStruct((out_rows_a, LANES), jnp.uint32)] * 3
+        + [jax.ShapeDtypeStruct((out_rows_b, LANES), jnp.uint32)]
+        + [jax.ShapeDtypeStruct((4,), jnp.int32)])
+
+    scratch = ([pltpu.SMEM((8,), jnp.int32),
+                pltpu.VMEM((5, LANES), jnp.uint32)]
+               + [pltpu.VMEM((BR + 8, LANES), jnp.uint32) for _ in range(4)]
+               + [pltpu.SemaphoreType.DMA((4,))])
+
+    def kernel(bits_ref, tag_ref, oA0, oA1, oA2, oB0, cnt_ref,
+               carr, tails, bufA0, bufA1, bufA2, bufB0, sems):
+        i = pl.program_id(0)
+        bits = bits_ref[:]
+        tag = tag_ref[:]
+
+        @pl.when(i == 0)
+        def _():
+            carr[0] = 0  # inclusive live-b count so far
+            carr[1] = 0  # inclusive output offset so far
+            carr[2] = 0  # running max of head b_before (monotone ≥ 0)
+            carr[4] = 0  # group A write pointer (n_emit)
+            carr[5] = 0  # group B write pointer (n_blive)
+            tails[:] = jnp.zeros((5, LANES), jnp.uint32)
+
+        # prev-element bits carry lives in tails row 4 (Mosaic has no
+        # scalar bitcast, so an SMEM i32 slot can't hold a u32 pattern);
+        # at i==0 any value ≠ bits[0,0] forces the first run head
+        prev_fill = jnp.where(i == 0, bits[0, 0] + jnp.uint32(1),
+                              tails[4, LANES - 1])
+        pb = flat_shift(bits, jnp.int32(1), fill=prev_fill,
+                        interpret=interpret)
+        neq = bits != pb
+        side = (tag >> 31) == 1
+        emit = ((tag >> 30) & 1) == 1
+        live = ((tag >> 29) & 1) == 1
+        idx_u = tag & jnp.uint32((1 << 29) - 1)
+
+        ib = ((~side) & live).astype(jnp.int32)
+        cumb = block_cumsum(ib, interpret) + carr[0]
+        bb_at = cumb - ib
+        # run-head b_before values are non-decreasing in key order, so a
+        # running max of (head ? value : 0) IS the per-run broadcast
+        headv = jnp.where(neq, bb_at, 0)
+        bb = jnp.maximum(block_cummax(headv, interpret), carr[2])
+        m_at = cumb - bb
+        eff_m = jnp.where(live, m_at, 0)
+        if emit_unmatched_a:
+            mm = jnp.where(side & emit, jnp.maximum(eff_m, 1), 0)
+        else:
+            mm = jnp.where(side & live, eff_m, 0)
+        offv = block_cumsum(mm, interpret) + carr[1]
+        start = offv - mm
+        delta2 = (bb - start) * 2 + (eff_m > 0).astype(jnp.int32)
+
+        # carries must update before the compaction writes bump wptrs
+        carr[0] = cumb[BR - 1, LANES - 1]
+        carr[1] = offv[BR - 1, LANES - 1]
+        carr[2] = bb[BR - 1, LANES - 1]
+        tails[4:5, :] = bits[BR - 1:BR, :]
+
+        mA = (mm > 0).astype(jnp.int32)
+        valsA = [idx_u,
+                 jax.lax.bitcast_convert_type(delta2, jnp.uint32),
+                 jax.lax.bitcast_convert_type(start, jnp.uint32)]
+        _compact_write(BR, mA, valsA, [oA0, oA1, oA2], carr, 4, tails, 0,
+                       [bufA0, bufA1, bufA2], sems, 0, interpret)
+        valsB = [idx_u - jnp.uint32(na)]
+        _compact_write(BR, ib, valsB, [oB0], carr, 5, tails, 3,
+                       [bufB0], sems, 3, interpret)
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _():
+            cnt_ref[0] = offv[BR - 1, LANES - 1]  # n_out
+            cnt_ref[1] = carr[4]                  # n_emit
+            cnt_ref[2] = carr[5]                  # n_blive
+            cnt_ref[3] = 0
+
+    res = pl.pallas_call(
+        kernel,
+        out_shape=out_shapes,
+        grid=(blocks,),
+        in_specs=[pl.BlockSpec((BR, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)] * 2,
+        out_specs=([pl.BlockSpec(memory_space=pl.ANY)] * 4
+                   + [pl.BlockSpec(memory_space=pltpu.SMEM)]),
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(b2, t2)
+    elist = res[0].reshape(-1)[:rows_a * LANES]
+    delc = res[1].reshape(-1)[:rows_a * LANES]
+    startsc = res[2].reshape(-1)[:rows_a * LANES]
+    blist = res[3].reshape(-1)[:rows_b * LANES]
+    return res[4], elist, delc, startsc, blist
+
+
+def _compact_write(BR, m, vals, out_refs, wptr, wslot, tails, trow0,
+                   bufs, sems, srow0, interpret):
+    """Compact the masked elements of `vals` (VMEM (BR,128) u32 values,
+    mask m int32 0/1) onto `out_refs` at the running write pointer
+    ``wptr[wslot]``, carrying the partial-row tail in rows trow0.. of
+    `tails` and using semaphores srow0.. of `sems`.
+
+    Staged-shift compaction: selected element at j must move UP by
+    d[j] = #unselected before j (monotone non-decreasing). Moving by
+    d's bits low-to-high is collision-free: for j1<j2 (both selected),
+    (d2 mod 2^b) - (d1 mod 2^b) <= d2-d1 < j2-j1, so partial positions
+    j - (d mod 2^b) stay strictly ordered. O(log span) cheap vector
+    passes — no in-VMEM scatter, no O(rows) sweeps."""
+    nstreams = len(vals)
     P = block_cumsum(m, interpret)
     cnt = P[BR - 1, LANES - 1]
-    base = wptr[0]
+    base = wptr[wslot]
     s = base % LANES
 
-    # Staged-shift compaction: selected element at j must move UP by
-    # d[j] = #unselected before j (monotone non-decreasing). Moving by
-    # d's bits low-to-high is collision-free: for j1<j2 (both selected),
-    # (d2 mod 2^b) - (d1 mod 2^b) <= d2-d1 < j2-j1, so partial positions
-    # j - (d mod 2^b) stay strictly ordered. O(log span) cheap vector
-    # passes — no in-VMEM scatter, no O(rows) sweeps.
     q = flat_iota((BR, LANES))
     d = q + 1 - P          # unselected before j (exclusive, j selected)
     pack = ((d.astype(jnp.uint32) << 1) | m.astype(jnp.uint32))
-    vals = [st[:] for st in streams]
+    vals = list(vals)
     span = BR * LANES
     k = 1
     b = 0
@@ -288,20 +457,39 @@ def _compact_streams(nstreams, BR, mask_ref, streams, out_refs, cnt_ref,
         v = jnp.where(valid, vals[k], jnp.uint32(0))
         ext = jnp.concatenate([v, jnp.zeros((8, LANES), v.dtype)])
         shifted = flat_shift(ext, s, 0, interpret)
-        first = jnp.where(lane1 < s, tails[k:k + 1, :], shifted[0:1, :])
+        first = jnp.where(lane1 < s, tails[trow0 + k:trow0 + k + 1, :],
+                          shifted[0:1, :])
         blk = jnp.concatenate([first, shifted[1:]])
         bufs[k][:] = blk
         pltpu.make_async_copy(
             bufs[k], out_refs[k].at[pl.ds(base // LANES, BR + 8)],
-            sems.at[k]).start()
+            sems.at[srow0 + k]).start()
     newp = base + cnt
     rel = newp // LANES - base // LANES
     for k in range(nstreams):
         pltpu.make_async_copy(
             bufs[k], out_refs[k].at[pl.ds(base // LANES, BR + 8)],
-            sems.at[k]).wait()
-        tails[k:k + 1, :] = bufs[k][pl.ds(rel, 1), :]
-    wptr[0] = newp
+            sems.at[srow0 + k]).wait()
+        tails[trow0 + k:trow0 + k + 1, :] = bufs[k][pl.ds(rel, 1), :]
+    wptr[wslot] = newp
+    return newp
+
+
+def _compact_streams(nstreams, BR, mask_ref, streams, out_refs, cnt_ref,
+                     wptr, tails, bufs, sems, interpret=False):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        wptr[0] = 0
+        for k in range(nstreams):
+            tails[k:k + 1, :] = jnp.zeros((1, LANES), jnp.uint32)
+
+    m = (mask_ref[:] != 0).astype(jnp.int32)
+    vals = [st[:] for st in streams]
+    base = wptr[0]  # write pointer before this block's compaction
+    newp = _compact_write(BR, m, vals, out_refs, wptr, 0, tails, 0,
+                          bufs, sems, 0, interpret)
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():

@@ -200,7 +200,10 @@ def shuffle(table: Table, hash_columns: Sequence) -> Table:
     out, new_emit, _cap = exchange(payload, targets, emit, ctx)
     dat, val = _payload_tuples(out, t.column_count)
     cols = _rebuild_columns(dat, val, t, t.column_names)
-    return Table(cols, ctx, new_emit)
+    result = Table(cols, ctx, new_emit)
+    # reference parity: Shuffle frees non-retained inputs (table.cpp:207)
+    table._free_if_unretained()
+    return result
 
 
 def hash_partition(table: Table, hash_columns: Sequence,
@@ -291,6 +294,213 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig
                             [f"lt-{i}" for i in range(nl)])
     cols += _rebuild_columns(rod, rov, right_d,
                              [f"rt-{nl + j}" for j in range(right_d.column_count)])
+    result = Table(cols, ctx, emit)
+    left._free_if_unretained()
+    right._free_if_unretained()
+    return result
+
+
+# ---------------------------------------------------------------------------
+# streaming / overlapped ring join (reference: ArrowJoin, arrow_join.hpp:
+# 50-198 — the streaming alternative to the barrier shuffle: two
+# ArrowAllToAlls drained incrementally while local joins run).
+#
+# TPU-native form: the BUILD side rotates around the mesh ring via
+# `lax.ppermute` while every shard joins its RESIDENT probe shard against
+# the visiting block — XLA's async collective-permute overlaps the next
+# block's transfer with the current block's join. The probe side is never
+# repartitioned at all, so total bytes on the ring ≈ size(build), vs
+# size(probe+build) through the all-to-all — the win when the build side
+# is small or the probe side is large and already resident.
+# ---------------------------------------------------------------------------
+
+
+def _varying(axis, tree):
+    """Mark a pytree as mesh-varying so fori_loop carries type-match the
+    ppermute/per-shard values produced inside the loop body."""
+    pc = getattr(jax.lax, "pcast", None)
+    if pc is not None:
+        return jax.tree.map(lambda x: jax.lax.pcast(x, axis, to="varying"),
+                            tree)
+    return jax.tree.map(lambda x: jax.lax.pvary(x, (axis,)), tree)  # pragma: no cover
+
+
+@lru_cache(maxsize=None)
+def _ring_count_fn(mesh, emit_unmatched_a: bool, nkeys: int):
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def kernel(lbits, lkv, lemit, rbits, rkv, remit):
+        def rot(t):
+            return jax.tree.map(lambda x: jax.lax.ppermute(x, axis, perm), t)
+
+        def step(k, carry):
+            (rb, rkvc, remc), pairs, amatched = carry
+            _, _, m, _, _ = _join.join_plan_keys(
+                lbits, lkv, lemit, rb, rkvc, remc, _join.JoinType.INNER)
+            pairs = pairs.at[k].set(m.sum(dtype=jnp.int32))
+            amatched = amatched | (m > 0)
+            return rot((rb, rkvc, remc)), pairs, amatched
+
+        pairs0, amatched0 = _varying(axis, (
+            jnp.zeros(world, jnp.int32), jnp.zeros(lemit.shape[0], bool)))
+        _, pairs, amatched = jax.lax.fori_loop(
+            0, world, step, ((rbits, rkv, remit), pairs0, amatched0))
+        n_extra = (lemit & ~amatched).sum(dtype=jnp.int32) \
+            if emit_unmatched_a else jnp.zeros((), jnp.int32)
+        counts = jnp.concatenate([pairs, n_extra[None]])
+        return replicated_gather(counts, axis, world)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
+                             out_specs=P()))
+
+
+@lru_cache(maxsize=None)
+def _ring_mat_fn(mesh, emit_unmatched_a: bool, cap_step: int, cap_extra: int,
+                 nkeys: int):
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    cap_total = world * cap_step + cap_extra
+
+    def kernel(lbits, lkv, lemit, rbits, rkv, remit, adat, aval, bdat, bval):
+        def rot(t):
+            return jax.tree.map(lambda x: jax.lax.ppermute(x, axis, perm), t)
+
+        def slab_like(x):
+            return jnp.zeros((cap_total,) + x.shape[1:], x.dtype)
+
+        slabs_a = tuple(slab_like(d) for d in adat)
+        slabs_av = tuple(jnp.zeros(cap_total, bool) for _ in adat)
+        slabs_b = tuple(slab_like(d) for d in bdat)
+        slabs_bv = tuple(jnp.zeros(cap_total, bool) for _ in bdat)
+        emit0 = jnp.zeros(cap_total, bool)
+        slabs_a, slabs_av, slabs_b, slabs_bv, emit0 = _varying(
+            axis, (slabs_a, slabs_av, slabs_b, slabs_bv, emit0))
+
+        def step(k, carry):
+            visit, slabs, amatched = carry
+            rb, rkvc, remc, bdat_v, bval_v = visit
+            sa, sav, sb, sbv, emit = slabs
+            _, lo, m, bperm, _ = _join.join_plan_keys(
+                lbits, lkv, lemit, rb, rkvc, remc, _join.JoinType.INNER)
+            lidx, ridx, e = _join.join_materialize_gids(
+                lo, m, bperm, jnp.zeros(remc.shape[0], bool), lemit,
+                _join.JoinType.INNER, cap_step, 0)
+            ad, av = _gather_side(adat, aval, lidx)
+            bd, bv = _gather_side(bdat_v, bval_v, ridx)
+            off = k * cap_step
+
+            def put(slab, block):
+                return jax.lax.dynamic_update_slice_in_dim(slab, block,
+                                                           off, 0)
+
+            slabs = (tuple(put(s, d) for s, d in zip(sa, ad)),
+                     tuple(put(s, v) for s, v in zip(sav, av)),
+                     tuple(put(s, d) for s, d in zip(sb, bd)),
+                     tuple(put(s, v) for s, v in zip(sbv, bv)),
+                     put(emit, e))
+            amatched = amatched | (m > 0)
+            return rot((rb, rkvc, remc, bdat_v, bval_v)), slabs, amatched
+
+        visit0 = (rbits, rkv, remit, bdat, bval)
+        amatched0 = _varying(axis, jnp.zeros(lemit.shape[0], bool))
+        _, slabs, amatched = jax.lax.fori_loop(
+            0, world, step,
+            (visit0, (slabs_a, slabs_av, slabs_b, slabs_bv, emit0),
+             amatched0))
+        sa, sav, sb, sbv, emit = slabs
+
+        if emit_unmatched_a:
+            un = _join._masked_indices(lemit & ~amatched, cap_extra)
+            ad, av = _gather_side(adat, aval, un)
+            hole = jnp.full(cap_extra, -1, jnp.int32)
+            bd, bv = _gather_side(bdat, bval, hole)
+            off = world * cap_step
+
+            def put(slab, block):
+                return jax.lax.dynamic_update_slice_in_dim(slab, block,
+                                                           off, 0)
+
+            sa = tuple(put(s, d) for s, d in zip(sa, ad))
+            sav = tuple(put(s, v) for s, v in zip(sav, av))
+            sb = tuple(put(s, d) for s, d in zip(sb, bd))
+            sbv = tuple(put(s, v) for s, v in zip(sbv, bv))
+            emit = put(emit, un >= 0)
+        return sa, sav, sb, sbv, emit
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 10,
+                             out_specs=spec))
+
+
+def distributed_join_ring(left: Table, right: Table,
+                          config: _join.JoinConfig) -> Table:
+    """Streaming ring join (ArrowJoin analog). INNER/LEFT/RIGHT; the
+    resident (probe) side is the left table (right for RIGHT joins) and
+    the other side rotates. FULL_OUTER falls back to the shuffle path.
+
+    Memory note: the per-shard output slab is world*cap_step + cap_extra
+    rows where cap_step covers the worst (shard, step) block — heavy key
+    skew inflates it; the shuffle path degrades more gracefully there.
+    """
+    ctx = left._ctx
+    world = ctx.get_world_size()
+    jt = config.type
+    if world == 1 or jt == _join.JoinType.FULL_OUTER:
+        return distributed_join(left, right, config)
+
+    left_d = shard.distribute(left, ctx)
+    right_d = shard.distribute(right, ctx)
+    lidx, ridx = config.left_column_idx, config.right_column_idx
+    lcols, rcols = table_mod.align_key_columns(left_d, right_d, lidx, ridx)
+
+    if jt == _join.JoinType.RIGHT:
+        a_t, a_cols, b_t, b_cols = right_d, rcols, left_d, lcols
+    else:
+        a_t, a_cols, b_t, b_cols = left_d, lcols, right_d, rcols
+    emit_un_a = jt != _join.JoinType.INNER
+
+    def prep(t, cols):
+        bits = tuple(shard.pin(b, ctx) for b in _order.sort_keys(cols))
+        kv = shard.pin(_all_valid(cols), ctx)
+        emit = shard.pin(t.emit_mask(), ctx)
+        dat = tuple(shard.pin(c.data, ctx) for c in t._columns)
+        val = tuple(shard.pin(c.valid_mask(), ctx) for c in t._columns)
+        return bits, kv, emit, dat, val
+
+    abits, akv, aemit, adat, aval = prep(a_t, a_cols)
+    bbits, bkv, bemit, bdat, bval = prep(b_t, b_cols)
+
+    seq = ctx.get_next_sequence()
+    with _phase("ring_join.count", seq):
+        counts = np.asarray(jax.device_get(_ring_count_fn(
+            ctx.mesh, emit_un_a, len(abits))(
+            abits, akv, aemit, bbits, bkv, bemit)))
+    pairs, extra = counts[:, :world], counts[:, world]
+    cap_step = _capacity(int(pairs.max())) if pairs.size else 1
+    cap_extra = _capacity(int(extra.max())) if emit_un_a else 0
+
+    with _phase("ring_join.materialize", seq):
+        sa, sav, sb, sbv, emit = _ring_mat_fn(
+            ctx.mesh, emit_un_a, cap_step, cap_extra, len(abits))(
+            abits, akv, aemit, bbits, bkv, bemit, adat, aval, bdat, bval)
+
+    na = a_t.column_count
+    a_cols_out = _rebuild_columns(sa, sav, a_t,
+                                  [f"a-{i}" for i in range(na)])
+    b_cols_out = _rebuild_columns(
+        sb, sbv, b_t, [f"b-{j}" for j in range(b_t.column_count)])
+    if jt == _join.JoinType.RIGHT:
+        cols = b_cols_out + a_cols_out
+        nl = b_t.column_count
+    else:
+        cols = a_cols_out + b_cols_out
+        nl = na
+    cols = [c.rename(f"lt-{i}" if i < nl else f"rt-{i}")
+            for i, c in enumerate(cols)]
     return Table(cols, ctx, emit)
 
 

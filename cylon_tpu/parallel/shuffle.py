@@ -184,6 +184,17 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
     max_pair = int(counts.max()) if counts.size else 0
     recv_max = int(counts.sum(axis=0).max()) if counts.size else 0
     mb = max_block if max_block is not None else MAX_BLOCK
+    # the memory pool bounds in-flight comm buffers (2*W*block rows per
+    # leaf both directions); shrink the block cap to fit the HBM budget —
+    # the reference's analog is the Allocator feeding receive buffers from
+    # the pool (arrow_all_to_all.cpp:234-247)
+    budget = ctx.memory_pool.comm_budget_bytes()
+    if budget:
+        bytes_per_row = sum(
+            int(np.dtype(x.dtype).itemsize) * int(np.prod(x.shape[1:]))
+            for x in jax.tree.leaves(payload)) or 4
+        while mb > 1024 and 4 * world * mb * bytes_per_row > budget:
+            mb //= 2
     # floor-pow2 the cap so the documented memory bound is never exceeded
     mb = 1 << (max(int(mb), 1).bit_length() - 1)
     block = min(_pow2(max_pair), mb)

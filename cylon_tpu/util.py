@@ -10,14 +10,14 @@ def pow2(n: int) -> int:
 
 
 def capacity(n: int) -> int:
-    """Static-capacity rounding with a 3-bit mantissa: the smallest
-    s * 2^e ≥ n with s ∈ [9, 16]. Overshoot ≤ 12.5% (vs up to 100% for
-    pow2) while still bounding distinct compiled programs to 8 per octave.
-    Used for OUTPUT capacities on the hot path, where every padded row
-    costs real gather/scan work."""
+    """Static-capacity rounding with a 4-bit mantissa: the smallest
+    s * 2^e ≥ n with s ∈ [17, 32]. Overshoot ≤ 6.25% (vs up to 100% for
+    pow2) while still bounding distinct compiled programs to 16 per
+    octave. Used for OUTPUT capacities on the hot path, where every
+    padded row costs real gather/scan work."""
     n = max(int(n), 1)
-    if n <= 16:
+    if n <= 32:
         return pow2(n)
-    e = (n - 1).bit_length() - 4
+    e = (n - 1).bit_length() - 5
     s = -(-n // (1 << e))
     return s << e

@@ -1,0 +1,70 @@
+"""Phase profile of the streaming join path at bench shapes."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cylon_tpu.ops import join as _join
+from cylon_tpu.ops import tpu_kernels as tk
+from cylon_tpu.util import capacity
+
+
+def timeit(fn, iters=3):
+    jax.block_until_ready(fn())
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    n = 1 << 24
+    rng = np.random.default_rng(0)
+    lk = jnp.asarray(rng.integers(0, n, n).astype(np.int32))
+    lv = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    rk = jnp.asarray(rng.integers(0, n, n).astype(np.int32))
+    rv = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    none1 = (None,)
+
+    t_plan = timeit(lambda: _join.plan_program_stream(
+        (lk,), none1, None, (rk,), none1, None, (False,),
+        _join.JoinType.INNER, interpret=False))
+    res = _join.plan_program_stream((lk,), none1, None, (rk,), none1, None,
+                                    (False,), _join.JoinType.INNER,
+                                    interpret=False)
+    counts, elist, delc, startsc, blist = res
+    n_out = int(jax.device_get(counts)[0])
+    cap = capacity(n_out)
+    print(f"plan_stream total: {t_plan*1e3:.1f} ms  n_out={n_out}")
+
+    # sort alone
+    bits = jnp.concatenate([lk.view(jnp.uint32) ^ jnp.uint32(1 << 31),
+                            rk.view(jnp.uint32) ^ jnp.uint32(1 << 31)])
+    tag = jnp.arange(2 * n, dtype=jnp.uint32)
+    srt = jax.jit(lambda a, b: jax.lax.sort((a, b), num_keys=2))
+    t_sort = timeit(lambda: srt(bits, tag))
+    print(f"  sort alone: {t_sort*1e3:.1f} ms")
+
+    bs, ts_ = srt(bits, tag)
+    kern = jax.jit(lambda b, t: tk.join_plan_stream(
+        b, t, n, n, emit_unmatched_a=False))
+    t_kern = timeit(lambda: kern(bs, ts_))
+    print(f"  pallas pass alone: {t_kern*1e3:.1f} ms")
+
+    t_mat = timeit(lambda: _join.materialize_program_stream(
+        counts, elist, delc, startsc, blist,
+        (lk, lv), (None, None), (rk, rv), (None, None),
+        _join.JoinType.INNER, cap))
+    print(f"materialize_stream: {t_mat*1e3:.1f} ms")
+
+    exp = jax.jit(lambda: _join._expand_compact(
+        elist, delc, startsc, blist, counts[0], counts[1], cap))
+    t_exp = timeit(exp)
+    print(f"  expand_compact alone: {t_exp*1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

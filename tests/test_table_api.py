@@ -1,0 +1,79 @@
+"""table_api id registry (reference: table_api.cpp:37-393), memory pool
+accounting and retain/free-after-use semantics."""
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import table_api as api
+
+
+@pytest.fixture
+def ctx():
+    return ct.CylonContext.Init()
+
+
+def _tbl(ctx, seed=0, n=100):
+    rng = np.random.default_rng(seed)
+    return ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, 20, n).astype(np.int64),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+
+
+def test_registry_roundtrip(ctx):
+    t = _tbl(ctx)
+    api.put_table("t1", t)
+    assert api.get_table("t1") is t
+    assert "t1" in api.registered_ids()
+    api.remove_table("t1")
+    with pytest.raises(ct.CylonError):
+        api.get_table("t1")
+
+
+def test_id_keyed_ops(ctx):
+    api.put_table("l", _tbl(ctx, 1))
+    api.put_table("r", _tbl(ctx, 2))
+    cfg = ct.JoinConfig.InnerJoin([0], [0])
+    assert api.join_tables("l", "r", cfg, "j").is_ok()
+    direct = api.get_table("l").join(api.get_table("r"), "inner", on="k")
+    assert api.row_count("j") == direct.row_count
+    assert api.column_count("j") == 4
+
+    assert api.union_tables("l", "r", "u").is_ok()
+    assert api.row_count("u") == api.get_table("l").union(
+        api.get_table("r")).row_count
+
+    assert api.sort_table("l", "ls", "k").is_ok()
+    ks = api.get_table("ls").get_column(0).to_numpy()
+    assert (np.diff(ks) >= 0).all()
+
+    assert api.project_table("l", "lp", ["v"]).is_ok()
+    assert api.column_count("lp") == 1
+
+    assert api.merge_tables(["l", "r"], "m").is_ok()
+    assert api.row_count("m") == 200
+    for i in ("l", "r", "j", "u", "ls", "lp", "m"):
+        api.remove_table(i)
+
+
+def test_memory_pool_accounting(ctx):
+    pool = ctx.memory_pool
+    # CPU test platform may not expose memory stats; the API must still
+    # answer without raising
+    assert pool.bytes_allocated() >= 0
+    assert pool.peak_bytes() >= 0
+    b = pool.comm_budget_bytes()
+    assert b is None or b > 0
+
+
+def test_retain_memory_frees_inputs():
+    dctx = ct.CylonContext.InitDistributed(ct.TPUConfig())
+    left, right = _tbl(dctx, 3, 400), _tbl(dctx, 4, 400)
+    keep = _tbl(dctx, 3, 400)
+    left.retain_memory(False)
+    out = left.distributed_join(right, "inner", on="k")
+    ref = keep.distributed_join(right, "inner", on="k")
+    assert out.row_count == ref.row_count
+    # the non-retained input was cleared after use, the retained one kept
+    assert left.column_count == 0
+    assert right.column_count == 2
