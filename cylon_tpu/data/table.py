@@ -339,6 +339,10 @@ class Table:
         cfg = self._make_join_config(table, join_type, algorithm, kwargs)
         if comm == "ring":
             return dist_ops.distributed_join_ring(self, table, cfg)
+        if comm != "shuffle":
+            raise CylonError(Code.Invalid,
+                             f"unknown comm mode {comm!r} "
+                             "(expected 'shuffle' or 'ring')")
         return dist_ops.distributed_join(self, table, cfg)
 
     def _make_join_config(self, table: "Table", join_type, algorithm, kwargs

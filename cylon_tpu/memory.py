@@ -28,21 +28,24 @@ class MemoryPool:
     def bytes_allocated(self) -> int:
         """Live HBM across local mesh devices (0 when the backend does not
         expose memory_stats, e.g. the CPU test platform)."""
-        return sum(_stats(d).get("bytes_in_use", 0)
-                   for d in self._devices)
+        return sum(s.get("bytes_in_use", 0)
+                   for d in self._devices if (s := _stats(d)) is not None)
 
     def peak_bytes(self) -> int:
-        return sum(_stats(d).get("peak_bytes_in_use", 0)
-                   for d in self._devices)
+        return sum(s.get("peak_bytes_in_use", 0)
+                   for d in self._devices if (s := _stats(d)) is not None)
 
     def bytes_limit(self) -> int:
-        return sum(_stats(d).get("bytes_limit", 0) for d in self._devices)
+        return sum(s.get("bytes_limit", 0)
+                   for d in self._devices if (s := _stats(d)) is not None)
 
     def available_bytes(self) -> Optional[int]:
         """Free HBM on the tightest local device; None when unknown."""
         per = []
         for d in self._devices:
             s = _stats(d)
+            if s is None:
+                continue
             limit, used = s.get("bytes_limit"), s.get("bytes_in_use")
             if limit:
                 per.append(limit - (used or 0))

@@ -501,7 +501,10 @@ def distributed_join_ring(left: Table, right: Table,
         nl = na
     cols = [c.rename(f"lt-{i}" if i < nl else f"rt-{i}")
             for i, c in enumerate(cols)]
-    return Table(cols, ctx, emit)
+    result = Table(cols, ctx, emit)
+    left._free_if_unretained()
+    right._free_if_unretained()
+    return result
 
 
 # ---------------------------------------------------------------------------

@@ -16,8 +16,8 @@ def capacity(n: int) -> int:
     octave. Used for OUTPUT capacities on the hot path, where every
     padded row costs real gather/scan work."""
     n = max(int(n), 1)
-    if n <= 32:
+    if n <= 16:
         return pow2(n)
-    e = (n - 1).bit_length() - 5
+    e = max((n - 1).bit_length() - 5, 0)
     s = -(-n // (1 << e))
     return s << e

@@ -40,10 +40,13 @@ def main():
     cap = capacity(n_out)
     print(f"plan_stream total: {t_plan*1e3:.1f} ms  n_out={n_out}")
 
-    # sort alone
+    # sort alone — with the REAL tag encoding (side<<31|emit<<30|live<<29)
+    # so the kernel below sees live rows, not an all-inert stream
     bits = jnp.concatenate([lk.view(jnp.uint32) ^ jnp.uint32(1 << 31),
                             rk.view(jnp.uint32) ^ jnp.uint32(1 << 31)])
-    tag = jnp.arange(2 * n, dtype=jnp.uint32)
+    iota = jnp.arange(2 * n, dtype=jnp.uint32)
+    tag = (jnp.where(iota < n, jnp.uint32(1 << 31), jnp.uint32(0))
+           | jnp.uint32(3 << 29) | iota)
     srt = jax.jit(lambda a, b: jax.lax.sort((a, b), num_keys=2))
     t_sort = timeit(lambda: srt(bits, tag))
     print(f"  sort alone: {t_sort*1e3:.1f} ms")
@@ -59,11 +62,6 @@ def main():
         (lk, lv), (None, None), (rk, rv), (None, None),
         _join.JoinType.INNER, cap))
     print(f"materialize_stream: {t_mat*1e3:.1f} ms")
-
-    exp = jax.jit(lambda: _join._expand_compact(
-        elist, delc, startsc, blist, counts[0], counts[1], cap))
-    t_exp = timeit(exp)
-    print(f"  expand_compact alone: {t_exp*1e3:.1f} ms")
 
 
 if __name__ == "__main__":
