@@ -21,10 +21,12 @@ from .io.csv import read_csv, read_csv_per_rank, write_csv
 from .io.parquet import read_parquet, write_parquet
 from .ops.groupby import AggregationOp
 from .ops.join import JoinAlgorithm, JoinConfig, JoinType
+from . import native
 from .parallel.dist_ops import (distributed_groupby, distributed_join,
                                 distributed_join_ring, distributed_set_op,
                                 distributed_sort, hash_partition,
                                 repartition, shuffle)
+from .parallel.shard import distribute_by_key
 from .status import Code, CylonError, Status
 
 __version__ = "0.1.0"
@@ -35,9 +37,9 @@ __all__ = [
     "DataType", "JoinAlgorithm", "JoinConfig", "JoinType", "Layout",
     "LocalConfig", "MPIConfig", "MultiHostConfig", "ParquetOptions", "Row",
     "Status", "TPUConfig", "Table", "Type", "concat_tables",
-    "distributed_groupby", "distributed_join", "distributed_join_ring",
-    "distributed_set_op",
-    "distributed_sort", "hash_partition", "join", "read_csv",
+    "distribute_by_key", "distributed_groupby", "distributed_join",
+    "distributed_join_ring", "distributed_set_op",
+    "distributed_sort", "hash_partition", "join", "native", "read_csv",
     "read_csv_per_rank",
     "read_parquet", "repartition", "set_op", "shuffle", "telemetry",
     "write_csv", "write_parquet",

@@ -48,6 +48,10 @@ class Table:
         self._ctx = ctx or CylonContext.Init()
         self._row_count_cache: Optional[int] = None
         self._row_mask = row_mask  # bool [n] or None (all rows live)
+        # co-partitioning witness: (key col idxs, key dtype sig, world) set
+        # by shuffle/distribute_by_key; lets a later shuffle on the same
+        # keys skip the exchange (parallel/dist_ops.shuffle)
+        self._hash_partitioned = None
         if columns:
             n = len(columns[0])
             for c in columns:
@@ -658,20 +662,27 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
                                               config.type)
     if use_stream:
         interp = jax.default_backend() != "tpu"
+        a_desc, b_desc = _join.plan_lane_descs(ldat, lval, rdat, rval,
+                                               config.type)
+        br = _join.stream_block_rows(lkeys[0].shape[0], rkeys[0].shape[0])
         with _telemetry.phase("join.plan", seq):
-            counts, elist, delc, startsc, blist = _join.plan_program_stream(
-                lkeys, lkvalid, lemit, rkeys, rkvalid, remit, str_flags,
-                config.type, interpret=interp)
+            counts, a_streams, b_streams = _join.plan_program_stream(
+                lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
+                ldat, lval, rdat, rval, str_flags, config.type,
+                a_desc=a_desc, b_desc=b_desc, block_rows=br,
+                interpret=interp)
             n_primary = int(jax.device_get(counts)[0])
         if n_primary < 0:
             raise CylonError(Code.ExecutionError,
                              "join output exceeds 2^31 rows per shard; "
                              "repartition over more shards")
-        cap_p = _capacity(n_primary)
+        cap_e = _join.stream_expand_capacity(n_primary, br)
         with _telemetry.phase("join.materialize", seq):
             lod, lov, rod, rov, emit = _join.materialize_program_stream(
-                counts, elist, delc, startsc, blist,
-                ldat, lval, rdat, rval, config.type, cap_p)
+                counts, a_streams, b_streams,
+                ldat, lval, rdat, rval, config.type, cap_e,
+                a_desc=a_desc, b_desc=b_desc, block_rows=br,
+                interpret=interp)
     else:
         with _telemetry.phase("join.plan", seq):
             counts2, lo, m, bperm, un_mask = _join.plan_program(

@@ -117,10 +117,31 @@ def _read_one(ctx: CylonContext, path: str, options: CSVReadOptions) -> Table:
 def write_csv(table: Table, path: str,
               options: Optional[CSVWriteOptions] = None) -> None:
     """Reference: Table::WriteCSV via PrintToOStream (table.cpp:429-440,
-    1091-1142)."""
+    1091-1142 — native C++ row stringify there, native C++ here: all-
+    numeric tables go through the multithreaded writer in
+    native/cylon_host.cpp; strings/temporal/bool fall back to pandas)."""
+    import jax
+    import numpy as np
+
     options = options or CSVWriteOptions()
-    df = table.to_pandas()
     names = options.GetColumnNames()
+    t = table.compact() if table.row_mask is not None else table
+    from .. import native as _native
+
+    native_ok = (
+        all(not c.is_string and not c.dtype.is_temporal()
+            and np.dtype(c.data.dtype) in _native.SUPPORTED_CSV_DTYPES
+            for c in t._columns)
+        and (names is None or len(names) == t.column_count))
+    if native_ok:
+        cols = [np.asarray(jax.device_get(c.data)) for c in t._columns]
+        valids = [c._host_mask() for c in t._columns]
+        out_names = list(names) if names is not None else \
+            [c.name or f"c{i}" for i, c in enumerate(t._columns)]
+        if _native.write_csv_numeric(cols, valids, out_names, path,
+                                     options.GetDelimiter()):
+            return
+    df = t.to_pandas()
     if names is not None:
         df.columns = names
     df.to_csv(path, sep=options.GetDelimiter(), index=False)

@@ -1,0 +1,371 @@
+"""ctypes binding for the native host runtime (native/cylon_host.cpp).
+
+The reference's engine is native C++ (cpp/src/cylon/); here the DEVICE
+engine is JAX/Pallas and this module binds its native HOST half: row
+hashing + hash partition for ingest placement (bit-identical to
+ops/hash.py), the multithreaded numeric CSV writer, Arrow validity-bitmap
+pack/unpack, and the staging-buffer pool.
+
+The library is built lazily on first use with the system C++ compiler
+(there is no pybind11 in this environment; plain C ABI + ctypes keeps the
+binding dependency-free). Every entry point has a numpy fallback so the
+framework works without a compiler; `available()` reports which path is
+active.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "_native", "libcylon_host.so")
+_SRC_PATH = os.path.join(os.path.dirname(_HERE), "native", "cylon_host.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_NULL_TAG = np.uint32(0x9E3779B9)
+_DTYPE_CODES = {np.dtype(np.int32): 0, np.dtype(np.int64): 1,
+                np.dtype(np.float32): 2, np.dtype(np.float64): 3,
+                np.dtype(np.uint32): 4, np.dtype(np.uint64): 5}
+# dtypes the native CSV writer handles — callers gate on this BEFORE
+# pulling device data to host
+SUPPORTED_CSV_DTYPES = frozenset(_DTYPE_CODES)
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+    # compile to a private temp name then atomically rename: concurrent
+    # processes (multi-host ingest, pytest-xdist) must never dlopen a
+    # half-written .so
+    tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
+    compilers = [os.environ["CXX"]] if "CXX" in os.environ else \
+        ["g++", "c++", "clang++"]
+    for cxx in compilers:
+        cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               "-o", tmp, _SRC_PATH]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+            os.replace(tmp, _SO_PATH)
+            return True
+        except (OSError, subprocess.SubprocessError):
+            continue
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+    return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        stale = (os.path.exists(_SO_PATH) and os.path.exists(_SRC_PATH)
+                 and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH))
+        if (not os.path.exists(_SO_PATH) or stale) and \
+                os.path.exists(_SRC_PATH):
+            if not _build() and not os.path.exists(_SO_PATH):
+                return None
+        if not os.path.exists(_SO_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.ct_version.restype = ctypes.c_int32
+        lib.ct_row_hash.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int32]
+        lib.ct_partition_from_hash.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32]
+        lib.ct_partition_order.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_uint32, ctypes.c_void_p]
+        lib.ct_pack_bitmap.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_void_p]
+        lib.ct_unpack_bitmap.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_void_p]
+        lib.ct_write_csv.restype = ctypes.c_int64
+        lib.ct_write_csv.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_char, ctypes.c_char_p,
+            ctypes.c_int32]
+        lib.ct_pool_alloc.restype = ctypes.c_void_p
+        lib.ct_pool_alloc.argtypes = [ctypes.c_size_t]
+        lib.ct_pool_free.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        lib.ct_pool_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the compiled native library is loadable (building it on
+    first call if a compiler is present)."""
+    return _load() is not None
+
+
+def _nthreads() -> int:
+    return min(os.cpu_count() or 1, 16)
+
+
+# ---------------------------------------------------------------------------
+# ordered bits on HOST numpy (mirror of ops/order.ordered_bits_raw)
+# ---------------------------------------------------------------------------
+
+
+def np_ordered_bits(x: np.ndarray) -> np.ndarray:
+    """Order-preserving unsigned bits of a host array (numpy mirror of
+    ops/order.ordered_bits_raw, so host hashes match device hashes)."""
+    x = np.asarray(x)
+    dt = x.dtype
+    if dt == np.bool_:
+        return x.astype(np.uint32)
+    if dt.kind == "u":
+        return x
+    if dt.kind in ("M", "m"):
+        x = x.view(np.int64)
+        dt = x.dtype
+    if dt.kind == "i":
+        u = np.dtype(f"u{dt.itemsize}")
+        return x.view(u) ^ np.array(1 << (8 * dt.itemsize - 1), u)
+    if dt.kind == "f":
+        u = np.dtype(f"u{dt.itemsize}")
+        xz = np.where(x == 0, np.zeros((), dt), x)
+        bits = xz.view(u) if xz.flags.c_contiguous else \
+            np.ascontiguousarray(xz).view(u)
+        sign = (bits >> (8 * dt.itemsize - 1)).astype(bool)
+        allones = np.array(~np.uint64(0) >> (64 - 8 * dt.itemsize), u)
+        signbit = np.array(np.uint64(1) << (8 * dt.itemsize - 1), u)
+        return np.where(sign, ~bits & allones, bits ^ signbit)
+    raise TypeError(f"unhashable dtype {dt}")
+
+
+def _norm_width(bits: np.ndarray) -> Tuple[np.ndarray, int]:
+    if bits.dtype.itemsize == 8:
+        return np.ascontiguousarray(bits.view(np.uint64)), 8
+    if bits.dtype.itemsize == 4:
+        return np.ascontiguousarray(bits.view(np.uint32)), 4
+    return np.ascontiguousarray(bits.astype(np.uint32)), 4
+
+
+def _fmix32_np(h: np.ndarray) -> np.ndarray:
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    return h ^ (h >> np.uint32(16))
+
+
+def _fmix64_np(h: np.ndarray) -> np.ndarray:
+    h = h ^ (h >> np.uint64(33))
+    h = h * np.uint64(0xFF51AFD7ED558CCD)
+    h = h ^ (h >> np.uint64(33))
+    h = h * np.uint64(0xC4CEB9FE1A85EC53)
+    return h ^ (h >> np.uint64(33))
+
+
+def row_hash(cols: Sequence[np.ndarray],
+             valids: Sequence[Optional[np.ndarray]],
+             is_string: Optional[Sequence[bool]] = None) -> np.ndarray:
+    """Combined per-row uint32 hash of host columns — same value the
+    device computes in ops/hash.hash_columns. `cols` are raw value arrays
+    (ordered-bit normalization happens here); string columns pass their
+    dictionary CODES with is_string=True (codes widen to u32 unsigned,
+    matching ops/order.ordered_bits_raw's string path)."""
+    n = len(cols[0])
+    flags = is_string or [False] * len(cols)
+    bit_cols: List[np.ndarray] = []
+    widths: List[int] = []
+    for c, s in zip(cols, flags):
+        bits = np.asarray(c).astype(np.uint32) if s else np_ordered_bits(c)
+        b, w = _norm_width(bits)
+        bit_cols.append(b)
+        widths.append(w)
+    vmasks = [None if v is None else
+              np.ascontiguousarray(np.asarray(v, dtype=np.uint8))
+              for v in valids]
+    lib = _load()
+    if lib is not None and n > 0:
+        out = np.empty(n, np.uint32)
+        nc = len(bit_cols)
+        col_ps = (ctypes.c_void_p * nc)(
+            *[c.ctypes.data_as(ctypes.c_void_p) for c in bit_cols])
+        width_a = (ctypes.c_int32 * nc)(*widths)
+        val_ps = (ctypes.c_void_p * nc)(
+            *[None if v is None else v.ctypes.data_as(ctypes.c_void_p)
+              for v in vmasks])
+        lib.ct_row_hash(col_ps, width_a, val_ps, nc, n,
+                        out.ctypes.data_as(ctypes.c_void_p), _nthreads())
+        return out
+    # numpy fallback
+    h = np.zeros(n, np.uint32)
+    for b, w, v in zip(bit_cols, widths, vmasks):
+        if w == 8:
+            m = _fmix64_np(b)
+            hc = (m ^ (m >> np.uint64(32))).astype(np.uint32)
+        else:
+            hc = _fmix32_np(b)
+        if v is not None:
+            hc = np.where(v.astype(bool), hc, _NULL_TAG)
+        h = h * np.uint32(31) + hc
+    return _fmix32_np(h)
+
+
+def hash_partition(cols: Sequence[np.ndarray],
+                   valids: Sequence[Optional[np.ndarray]],
+                   world: int, is_string: Optional[Sequence[bool]] = None
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side hash partition: (targets i32[n], counts i64[world],
+    order i64[n]) where `order` is the stable row permutation grouping
+    rows by target — gathering rows by `order` and splitting at cumsum
+    (counts) yields the per-target row sets. Placement is bit-identical
+    to the device's ops/hash.partition_targets, so host-ingest placement
+    and device shuffle placement agree."""
+    h = row_hash(cols, valids, is_string)
+    n = len(h)
+    lib = _load()
+    if lib is not None and n > 0:
+        targets = np.empty(n, np.int32)
+        counts = np.zeros(world, np.int64)
+        order = np.empty(n, np.int64)
+        lib.ct_partition_from_hash(
+            h.ctypes.data_as(ctypes.c_void_p), n, world,
+            targets.ctypes.data_as(ctypes.c_void_p),
+            counts.ctypes.data_as(ctypes.c_void_p), _nthreads())
+        lib.ct_partition_order(
+            targets.ctypes.data_as(ctypes.c_void_p), n,
+            counts.ctypes.data_as(ctypes.c_void_p), world,
+            order.ctypes.data_as(ctypes.c_void_p))
+        return targets, counts, order
+    targets = (h % np.uint32(world)).astype(np.int32)
+    counts = np.bincount(targets, minlength=world).astype(np.int64)
+    order = np.argsort(targets, kind="stable").astype(np.int64)
+    return targets, counts, order
+
+
+def pack_bitmap(mask: np.ndarray) -> np.ndarray:
+    """Byte mask → Arrow LSB validity bitmap."""
+    mask = np.ascontiguousarray(np.asarray(mask, dtype=np.uint8))
+    n = len(mask)
+    lib = _load()
+    if lib is not None:
+        bits = np.empty((n + 7) // 8, np.uint8)
+        lib.ct_pack_bitmap(mask.ctypes.data_as(ctypes.c_void_p), n,
+                           bits.ctypes.data_as(ctypes.c_void_p))
+        return bits
+    return np.packbits(mask.astype(bool), bitorder="little")
+
+
+def unpack_bitmap(bits: np.ndarray, n: int) -> np.ndarray:
+    """Arrow LSB validity bitmap → bool array of length n."""
+    bits = np.ascontiguousarray(np.asarray(bits, dtype=np.uint8))
+    lib = _load()
+    if lib is not None:
+        out = np.empty(n, np.uint8)
+        lib.ct_unpack_bitmap(bits.ctypes.data_as(ctypes.c_void_p), n,
+                             out.ctypes.data_as(ctypes.c_void_p))
+        return out.astype(bool)
+    return np.unpackbits(bits, count=n, bitorder="little").astype(bool)
+
+
+def write_csv_numeric(cols: Sequence[np.ndarray],
+                      valids: Sequence[Optional[np.ndarray]],
+                      names: Sequence[str], path: str,
+                      sep: str = ",") -> bool:
+    """Write numeric columns as CSV with the native multithreaded writer.
+    Returns False (caller should fall back) when the library is missing
+    or a column dtype is unsupported."""
+    lib = _load()
+    if lib is None:
+        return False
+    # the native writer emits header names verbatim and takes a single-
+    # byte separator; names needing CSV quoting or exotic delimiters go
+    # through the pandas fallback
+    if len(sep.encode("utf-8", "ignore")) != 1 or not sep.isascii():
+        return False
+    if any(sep in s or '"' in s or "\n" in s or "\r" in s for s in names):
+        return False
+    ncols = len(cols)
+    if len(names) != ncols or len(valids) != ncols:
+        return False
+    n = len(cols[0]) if ncols else 0
+    codes = []
+    ccols = []
+    for c in cols:
+        c = np.ascontiguousarray(c)
+        code = _DTYPE_CODES.get(c.dtype)
+        if code is None:
+            return False
+        codes.append(code)
+        ccols.append(c)
+    vmasks = [None if v is None else
+              np.ascontiguousarray(np.asarray(v, dtype=np.uint8))
+              for v in valids]
+    col_ps = (ctypes.c_void_p * ncols)(
+        *[c.ctypes.data_as(ctypes.c_void_p) for c in ccols])
+    code_a = (ctypes.c_int32 * ncols)(*codes)
+    val_ps = (ctypes.c_void_p * ncols)(
+        *[None if v is None else v.ctypes.data_as(ctypes.c_void_p)
+          for v in vmasks])
+    name_a = (ctypes.c_char_p * ncols)(
+        *[s.encode("utf-8") for s in names])
+    r = lib.ct_write_csv(col_ps, code_a, val_ps, ncols, n, name_a,
+                         sep.encode("ascii"), path.encode("utf-8"),
+                         _nthreads())
+    return r >= 0
+
+
+class _PooledArray(np.ndarray):
+    """ndarray view over a pooled buffer; carries the pool address."""
+
+    _ct_pool_addr: int = 0
+
+
+class StagingPool:
+    """Aligned host staging-buffer pool (the host-side MemoryPool analog,
+    reference ctx/memory_pool.hpp:25-66). `take(nbytes)` returns a numpy
+    uint8 view over a pooled 64-byte-aligned buffer; `give` returns it."""
+
+    def take(self, nbytes: int) -> Optional[np.ndarray]:
+        lib = _load()
+        if lib is None:
+            return np.empty(nbytes, np.uint8)
+        p = lib.ct_pool_alloc(ctypes.c_size_t(nbytes))
+        if not p:
+            return None
+        buf = (ctypes.c_uint8 * nbytes).from_address(p)
+        arr = np.frombuffer(buf, dtype=np.uint8).view(_PooledArray)
+        arr._ct_pool_addr = p
+        return arr
+
+    def give(self, arr: np.ndarray) -> None:
+        lib = _load()
+        addr = getattr(arr, "_ct_pool_addr", 0)
+        if lib is None or not addr:
+            return
+        lib.ct_pool_free(ctypes.c_void_p(addr),
+                         ctypes.c_size_t(arr.nbytes))
+
+    def stats(self) -> Tuple[int, int]:
+        lib = _load()
+        if lib is None:
+            return (0, 0)
+        live = ctypes.c_int64()
+        free = ctypes.c_int64()
+        lib.ct_pool_stats(ctypes.byref(live), ctypes.byref(free))
+        return (live.value, free.value)

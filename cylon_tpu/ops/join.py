@@ -440,12 +440,80 @@ def stream_plan_applicable(lkeys, rkeys, str_flags,
     return jax.default_backend() == "tpu"
 
 
-@partial(jax.jit, static_argnames=("str_flags", "join_type", "interpret"))
-def plan_program_stream(lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
-                        str_flags, join_type: JoinType,
-                        interpret: bool = False):
-    """Phase 1 (stream path): raw key columns → sorted stream → Pallas
-    plan pass. Only counts[4] crosses to the host."""
+# Shared sort-payload slot budget: each slot adds one u32 operand to the
+# fused plan sort (measured on v5e at 33M rows: +2 operands free, +5 ≈
+# +100 ms). Columns beyond the budget fall back to aidx/bidx gathers.
+MAX_SHARED_LANES = 8
+
+
+def plan_lane_descs(ldat, lval, rdat, rval, join_type: JoinType):
+    """Static lane packing for the stream path: which columns ride the
+    plan sort as u32 payload lanes. Slot s carries the probe side's lane
+    s at probe rows and the build side's lane s at build rows, so the
+    operand count is max(a, b) lanes, not the sum.
+
+    Returns hashable (a_desc, b_desc): tuples of (col_idx, kind) with
+    kind "d" (data, bit-exact u32 reinterpret) or "v" (validity widened
+    to u32). 4-byte 1-D non-bool columns qualify; the rest (8-byte,
+    bool) use the index-gather fallback in materialize."""
+    if join_type == JoinType.RIGHT:
+        adat, aval, bdat, bval = rdat, rval, ldat, lval
+    else:
+        adat, aval, bdat, bval = ldat, lval, rdat, rval
+
+    def side(dat, val):
+        desc = []
+        for ci, (d, v) in enumerate(zip(dat, val)):
+            need = 1 + (1 if v is not None else 0)
+            if (d.ndim == 1 and d.dtype.itemsize == 4
+                    and d.dtype != jnp.bool_
+                    and len(desc) + need <= MAX_SHARED_LANES):
+                desc.append((ci, "d"))
+                if v is not None:
+                    desc.append((ci, "v"))
+        return tuple(desc)
+
+    return side(adat, aval), side(bdat, bval)
+
+
+def stream_block_rows(na: int, nb: int) -> int:
+    """ONE Pallas block-rows choice for plan AND expand (the expansion
+    window slack requires expand block_rows <= plan block_rows): small
+    inputs use small blocks — the kernel graphs (log-shift compaction,
+    window sweeps) scale with the block span, and small-block variants
+    trace/compile ~3x faster, which dominates interpreter-mode tests."""
+    return 8 if (na + nb) < (1 << 20) else 64
+
+
+def stream_expand_capacity(n: int, block_rows: int):
+    """cap_e for join_expand_stream: the mantissa-rounded capacity lifted
+    to a whole number of expansion blocks."""
+    blk = block_rows * 128
+    from ..util import capacity as _cap
+
+    cap = _cap(max(n, 1))
+    return -(-cap // blk) * blk
+
+
+def _side_lanes(dat, val, desc):
+    lanes = []
+    for ci, kind in desc:
+        if kind == "d":
+            d = dat[ci]
+            lanes.append(d if d.dtype == jnp.uint32 else d.view(jnp.uint32))
+        else:
+            lanes.append(val[ci].astype(jnp.uint32))
+    return lanes
+
+
+def _plan_program_stream_impl(lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
+                              ldat, lval, rdat, rval,
+                              str_flags, join_type: JoinType,
+                              a_desc=(), b_desc=(), block_rows: int = 64,
+                              interpret: bool = False):
+    """Phase 1 (stream path): raw key columns → sorted stream (payload
+    lanes riding along) → Pallas plan pass that compacts the plan AND the
+    payload into groups A/B. Only counts[4] crosses to the host."""
     from . import tpu_kernels as tk
 
     lbits, lkv, rbits, rkv = _keys_to_bits(lkeys, lkvalid, rkeys, rkvalid,
@@ -455,9 +523,11 @@ def plan_program_stream(lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
     if join_type == JoinType.RIGHT:
         abits, akv, aemit = rbits, rkv, remit
         bbits, bkv, bemit = lbits, lkv, lemit
+        adat, aval, bdat, bval = rdat, rval, ldat, lval
     else:
         abits, akv, aemit = lbits, lkv, lemit
         bbits, bkv, bemit = rbits, rkv, remit
+        adat, aval, bdat, bval = ldat, lval, rdat, rval
     na, nb = aemit.shape[0], bemit.shape[0]
     n = na + nb
 
@@ -470,104 +540,76 @@ def plan_program_stream(lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
            | (live.astype(jnp.uint32) << 29) | iota)
     bits = jnp.concatenate([abits[0], bbits[0]])
     bits = jnp.where(live, bits, jnp.uint32(0xFFFFFFFF))
-    bits_s, tag_s = jax.lax.sort((bits, tag), num_keys=2)
+
+    a_lanes = _side_lanes(adat, aval, a_desc)
+    b_lanes = _side_lanes(bdat, bval, b_desc)
+    lanes = []
+    for s in range(max(len(a_lanes), len(b_lanes))):
+        al = a_lanes[s] if s < len(a_lanes) else jnp.zeros(na, jnp.uint32)
+        bl = b_lanes[s] if s < len(b_lanes) else jnp.zeros(nb, jnp.uint32)
+        lanes.append(jnp.concatenate([al, bl]))
+
+    res = jax.lax.sort((bits, tag) + tuple(lanes), num_keys=2)
+    bits_s, tag_s, lanes_s = res[0], res[1], res[2:]
     return tk.join_plan_stream(bits_s, tag_s, na, nb,
                                emit_unmatched_a=join_type != JoinType.INNER,
-                               interpret=interpret)
+                               lanes=lanes_s, n_a_lanes=len(a_lanes),
+                               n_b_lanes=len(b_lanes),
+                               block_rows=block_rows, interpret=interpret)
 
 
-def _pack_side(dat, val):
-    """Split a side's columns into u32-packable lanes (4-byte 1-D data +
-    validity widened to u32) and a fallback list of the rest.
-    Returns (lanes, lane_plan, fallback_idx): lane_plan[ci] = (data_lane,
-    validity_lane_or_None) for packed columns."""
-    lanes: list = []
-    lane_plan: dict = {}
-    fallback = []
-    for ci, (d, v) in enumerate(zip(dat, val)):
-        if d.ndim == 1 and d.shape[0] > 0 and d.dtype.itemsize == 4 \
-                and d.dtype != jnp.bool_:
-            dl = len(lanes)
-            lanes.append(d if d.dtype == jnp.uint32 else d.view(jnp.uint32))
-            vl = None
-            if v is not None:
-                vl = len(lanes)
-                lanes.append(v.astype(jnp.uint32))
-            lane_plan[ci] = (dl, vl)
-        else:
-            fallback.append(ci)
-    return lanes, lane_plan, fallback
+_plan_program_stream_jit = partial(
+    jax.jit, static_argnames=("str_flags", "join_type", "a_desc", "b_desc",
+                              "block_rows",
+                              "interpret"))(_plan_program_stream_impl)
 
 
-@partial(jax.jit, static_argnames=("join_type", "cap_p"))
-def materialize_program_stream(counts, elist, delc, startsc, blist,
-                               ldat, lval, rdat, rval,
-                               join_type: JoinType, cap_p: int):
-    """Phase 2 (stream path): compacted plan → payload. Returns
-    (ldat', lval', rdat', rval', emit).
+def plan_program_stream(*args, interpret: bool = False, **kw):
+    """Dispatch: compiled on TPU; EAGER under the interpreter (tests) —
+    jitting the interpreted Pallas graph costs ~70 s of XLA CPU compile
+    per shape variant, while eager execution of test-sized inputs is
+    milliseconds."""
+    if interpret:
+        return _plan_program_stream_impl(*args, interpret=True, **kw)
+    return _plan_program_stream_jit(*args, interpret=False, **kw)
 
-    The hot passes are output-sized (cap_p ≈ n_out rows), so the design
-    minimizes THEIR count: 4-byte payload columns are pre-gathered into
-    the plan's compacted orders (a-side by `elist` into run-ordinal
-    order, b-side by `blist` into key order — both ~input-sized packed
-    row gathers), after which the expansion needs only TWO output-sized
-    row gathers — the run-plan matrix at the covering ordinal and the
-    b-matrix at the monotone b-position — with payload lanes riding
-    along. Row indices (aidx/bidx) are materialized only for columns
-    that can't ride a u32 lane (8-byte, bool, empty)."""
-    n_out, n_emit = counts[0], counts[1]
-    na_pad = elist.shape[0]
-    el = jax.lax.bitcast_convert_type(elist, jnp.int32)
-    dc = jax.lax.bitcast_convert_type(delc, jnp.int32)
-    st = jax.lax.bitcast_convert_type(startsc, jnp.int32)
-    bl = jax.lax.bitcast_convert_type(blist, jnp.int32)
+
+def _materialize_program_stream_impl(counts, a_streams, b_streams,
+                                     ldat, lval, rdat, rval,
+                                     join_type: JoinType, cap_e: int,
+                                     a_desc=(), b_desc=(),
+                                     block_rows: int = 64,
+                                     interpret: bool = False):
+    """Phase 2 (stream path): compacted plan + payload lanes → output
+    rows via the streaming expansion kernel. Returns (ldat', lval',
+    rdat', rval', emit). Columns that rode sort lanes are unpacked from
+    the kernel's lane outputs (zero output-sized XLA gathers); the rest
+    gather by the materialized aidx/bidx."""
+    from . import tpu_kernels as tk
+
+    aidx, bidx, a_lane_outs, b_lane_outs = tk.join_expand_stream(
+        counts, a_streams, b_streams, cap_e, block_rows=block_rows,
+        interpret=interpret)
+    valid = aidx >= 0
+    bhit = bidx >= 0
 
     if join_type == JoinType.RIGHT:
         adat, aval, bdat, bval = rdat, rval, ldat, lval
     else:
         adat, aval, bdat, bval = ldat, lval, rdat, rval
 
-    a_lanes, a_plan, a_fb = _pack_side(adat, aval)
-    b_lanes, b_plan, b_fb = _pack_side(bdat, bval)
-
-    # pre-gather packable payload into plan order (input-sized passes);
-    # matrices are PURE u32 — mixed-dtype stack would promote (to i64
-    # under x64) and break the 4-byte lane bitcasts
-    el_safe = jnp.maximum(el, 0)
-    bl_safe = jnp.maximum(bl, 0)
-    amat = jnp.stack(
-        [elist, delc] + [jnp.take(x, el_safe) for x in a_lanes], axis=1)
-    bmat = jnp.stack(
-        [blist] + [jnp.take(x, bl_safe) for x in b_lanes], axis=1)
-
-    # expansion: run-covering ordinal via unique-start scatter + cumsum
-    r = jnp.arange(na_pad, dtype=jnp.int32)
-    z = jnp.zeros(cap_p, jnp.int32).at[
-        jnp.where(r < n_emit, st, cap_p)].set(1, mode="drop")
-    c = jnp.cumsum(z)
-    ordx = jnp.maximum(c - 1, 0)
-    ga = jnp.take(amat, ordx, axis=0, mode="clip")   # output-sized pass 1
-    i = jax.lax.bitcast_convert_type(ga[:, 0], jnp.int32)
-    d2 = jax.lax.bitcast_convert_type(ga[:, 1], jnp.int32)
-    has = (d2 & 1) == 1
-    j = jnp.arange(cap_p, dtype=jnp.int32)
-    valid = j < n_out
-    bpos = jnp.clip(j + (d2 >> 1), 0, max(bl.shape[0] - 1, 0))
-    gb = jnp.take(bmat, bpos, axis=0, mode="clip")   # output-sized pass 2
-    bhit = has & valid
-
-    aidx = jnp.where(valid, i, -1)
-    bidx = jnp.where(bhit,
-                     jax.lax.bitcast_convert_type(gb[:, 0], jnp.int32), -1)
-
-    def unpack(dat, val, plan, fb, g, off, hit, idx):
+    def unpack(dat, val, desc, lane_outs, hit, idx):
         od: list = [None] * len(dat)
         ov: list = [None] * len(dat)
-        for ci, (dl, vl) in plan.items():
-            lane = g[:, off + dl]
-            od[ci] = jnp.where(hit, lane, 0) if dat[ci].dtype == jnp.uint32 \
-                else jnp.where(hit, lane, 0).view(dat[ci].dtype)
-            ov[ci] = hit if vl is None else ((g[:, off + vl] != 0) & hit)
+        for (ci, kind), lane in zip(desc, lane_outs):
+            if kind == "d":
+                od[ci] = lane if dat[ci].dtype == jnp.uint32 \
+                    else lane.view(dat[ci].dtype)
+                if val[ci] is None:
+                    ov[ci] = hit
+            else:
+                ov[ci] = (lane != 0) & hit
+        fb = [ci for ci in range(len(dat)) if od[ci] is None]
         if fb:
             fbd, fbv = gather_columns(
                 tuple(dat[ci] for ci in fb), tuple(val[ci] for ci in fb),
@@ -576,13 +618,27 @@ def materialize_program_stream(counts, elist, delc, startsc, blist,
                 od[ci], ov[ci] = fbd[k], fbv[k]
         return tuple(od), tuple(ov)
 
-    aod, aov = unpack(adat, aval, a_plan, a_fb, ga, 2, valid, aidx)
-    bod, bov = unpack(bdat, bval, b_plan, b_fb, gb, 1, bhit, bidx)
+    aod, aov = unpack(adat, aval, a_desc, a_lane_outs, valid, aidx)
+    bod, bov = unpack(bdat, bval, b_desc, b_lane_outs, bhit, bidx)
     if join_type == JoinType.RIGHT:
         lod, lov, rod, rov = bod, bov, aod, aov
     else:
         lod, lov, rod, rov = aod, aov, bod, bov
     return lod, lov, rod, rov, valid
+
+
+_materialize_program_stream_jit = partial(
+    jax.jit, static_argnames=("join_type", "cap_e", "a_desc", "b_desc",
+                              "block_rows",
+                              "interpret"))(_materialize_program_stream_impl)
+
+
+def materialize_program_stream(*args, interpret: bool = False, **kw):
+    """Dispatch twin of plan_program_stream: compiled on TPU, eager under
+    the interpreter."""
+    if interpret:
+        return _materialize_program_stream_impl(*args, interpret=True, **kw)
+    return _materialize_program_stream_jit(*args, interpret=False, **kw)
 
 
 def _vm(v, n):

@@ -34,7 +34,7 @@ default path).
 """
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +43,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
 _I32MAX = jnp.iinfo(jnp.int32).max
+
+
+def _x32_trace():
+    """Context: trace kernel bodies with x64 disabled. Under
+    jax_enable_x64, jnp.take_along_axis promotes its indices to int64 and
+    Mosaic's int64 convert_element_type rule recurses forever; every
+    kernel here is 32-bit by construction, so the promotion is never
+    wanted."""
+    return jax.enable_x64(False)
 
 
 def _roll(x, k, axis, interpret=False):
@@ -259,7 +268,9 @@ def stream_compact(mask: jnp.ndarray, streams: Sequence[jnp.ndarray],
         scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
         interpret=interpret,
-    )(m2, *s2)
+    )
+    with _x32_trace():
+        res = res(m2, *s2)
     outs, count = res[:nstreams], res[nstreams][0]
     flat = tuple(
         o.reshape(-1)[:rows * LANES].view(s.dtype)
@@ -275,6 +286,9 @@ def stream_compact(mask: jnp.ndarray, streams: Sequence[jnp.ndarray],
 
 def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
                      nb: int, emit_unmatched_a: bool,
+                     lanes: Sequence[jnp.ndarray] = (),
+                     n_a_lanes: Optional[int] = None,
+                     n_b_lanes: Optional[int] = None,
                      block_rows: int = 64, interpret: bool = False):
     """ONE sequential pass over the key-sorted row stream that computes the
     whole join plan — the Pallas replacement for the XLA scatter/gather
@@ -285,6 +299,10 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
       bits_s: u32 order-normalized key bits; dead rows forced to ~0.
       tag_s:  u32 ``side<<31 | emit<<30 | live<<29 | iota`` — probe (a)
               rows carry side=1 and sort after build (b) rows within a run.
+      lanes:  u32 payload streams that rode the SAME sort (slot s holds
+              a-side column s at a rows, b-side column s at b rows) —
+              they are compacted into both groups so the expansion kernel
+              never has to random-gather payload from HBM.
 
     Per element the pass derives, with SMEM carries across the sequential
     grid: the live-b prefix count (block_cumsum), run boundaries (shifted
@@ -293,17 +311,25 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
     broadcast — no scatter), match count m, output offsets (cumsum of
     per-row multiplicity), and stream-compacts two groups:
       group A (emitting probe rows): {orig index, packed delta2,
-              output start} — the expansion plan;
-      group B (live build rows):     {orig index} — the key-ordered build
-              permutation (bperm analog).
+              output start, payload lanes…} — the expansion plan;
+      group B (live build rows):     {orig index, payload lanes…} — the
+              key-ordered build permutation (bperm analog).
 
-    Returns (counts i32[4] = [n_out, n_emit, n_blive, 0], elist u32,
-    delc u32 (bitcast int32 delta2), startsc u32, blist u32); compacted
-    outputs are padded, entries beyond their count are garbage —
-    consumers mask by the counts (join_materialize_compact).
+    Returns (counts i32[4] = [n_out, n_emit, n_blive, 0], a_streams,
+    b_streams) where a_streams = (elist, delc, startsc, a_lane…) and
+    b_streams = (blist, b_lane…), each a PADDED (rows, LANES) u32 block
+    array; entries beyond their count are garbage — consumers mask by the
+    counts (join_expand_stream).
     """
     n = bits_s.shape[0]
     BR = block_rows
+    L = len(lanes)
+    # lane slot s holds a-side column s at a rows and b-side column s at b
+    # rows; when the sides pack unequal lane counts, the narrow side's
+    # group only compacts ITS lanes (the tail slots are the other side's)
+    La = L if n_a_lanes is None else n_a_lanes
+    Lb = L if n_b_lanes is None else n_b_lanes
+    nA, nB = 3 + La, 1 + Lb
     assert BR % 8 == 0 and BR >= 8
     assert n < (1 << 29)
     blocks = max(-(-n // (BR * LANES)), 1)
@@ -311,6 +337,7 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
     allones = jnp.uint32(0xFFFFFFFF)
     b2 = pad_rows(bits_s, rows, fill=allones)
     t2 = pad_rows(tag_s, rows, fill=0)  # side=0, live=0 → inert
+    l2 = [pad_rows(x, rows, fill=0) for x in lanes]
 
     rows_a = rows_for(max(na, 1))
     rows_b = rows_for(max(nb, 1))
@@ -318,20 +345,30 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
     out_rows_b = rows_b + BR + 8
 
     out_shapes = (
-        [jax.ShapeDtypeStruct((out_rows_a, LANES), jnp.uint32)] * 3
-        + [jax.ShapeDtypeStruct((out_rows_b, LANES), jnp.uint32)]
+        [jax.ShapeDtypeStruct((out_rows_a, LANES), jnp.uint32)] * nA
+        + [jax.ShapeDtypeStruct((out_rows_b, LANES), jnp.uint32)] * nB
         + [jax.ShapeDtypeStruct((4,), jnp.int32)])
 
     scratch = ([pltpu.SMEM((8,), jnp.int32),
-                pltpu.VMEM((5, LANES), jnp.uint32)]
-               + [pltpu.VMEM((BR + 8, LANES), jnp.uint32) for _ in range(4)]
-               + [pltpu.SemaphoreType.DMA((4,))])
+                pltpu.VMEM((nA + nB + 1, LANES), jnp.uint32)]
+               + [pltpu.VMEM((BR + 8, LANES), jnp.uint32)
+                  for _ in range(nA + nB)]
+               + [pltpu.SemaphoreType.DMA((nA + nB,))])
 
-    def kernel(bits_ref, tag_ref, oA0, oA1, oA2, oB0, cnt_ref,
-               carr, tails, bufA0, bufA1, bufA2, bufB0, sems):
+    def kernel(bits_ref, tag_ref, *rest):
+        lane_refs = rest[:L]
+        outsA = rest[L:L + nA]
+        outsB = rest[L + nA:L + nA + nB]
+        cnt_ref = rest[L + nA + nB]
+        carr = rest[L + nA + nB + 1]
+        tails = rest[L + nA + nB + 2]
+        bufsA = list(rest[L + nA + nB + 3:L + nA + nB + 3 + nA])
+        bufsB = list(rest[L + nA + nB + 3 + nA:L + nA + nB + 3 + nA + nB])
+        sems = rest[L + nA + nB + 3 + nA + nB]
         i = pl.program_id(0)
         bits = bits_ref[:]
         tag = tag_ref[:]
+        lane_vals = [r[:] for r in lane_refs]
 
         @pl.when(i == 0)
         def _():
@@ -340,13 +377,13 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
             carr[2] = 0  # running max of head b_before (monotone ≥ 0)
             carr[4] = 0  # group A write pointer (n_emit)
             carr[5] = 0  # group B write pointer (n_blive)
-            tails[:] = jnp.zeros((5, LANES), jnp.uint32)
+            tails[:] = jnp.zeros((nA + nB + 1, LANES), jnp.uint32)
 
-        # prev-element bits carry lives in tails row 4 (Mosaic has no
-        # scalar bitcast, so an SMEM i32 slot can't hold a u32 pattern);
+        # prev-element bits carry lives in the LAST tails row (Mosaic has
+        # no scalar bitcast, so an SMEM i32 slot can't hold a u32 pattern);
         # at i==0 any value ≠ bits[0,0] forces the first run head
         prev_fill = jnp.where(i == 0, bits[0, 0] + jnp.uint32(1),
-                              tails[4, LANES - 1])
+                              tails[nA + nB, LANES - 1])
         pb = flat_shift(bits, jnp.int32(1), fill=prev_fill,
                         interpret=interpret)
         neq = bits != pb
@@ -376,17 +413,18 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
         carr[0] = cumb[BR - 1, LANES - 1]
         carr[1] = offv[BR - 1, LANES - 1]
         carr[2] = bb[BR - 1, LANES - 1]
-        tails[4:5, :] = bits[BR - 1:BR, :]
+        tails[nA + nB:nA + nB + 1, :] = bits[BR - 1:BR, :]
 
         mA = (mm > 0).astype(jnp.int32)
         valsA = [idx_u,
                  jax.lax.bitcast_convert_type(delta2, jnp.uint32),
-                 jax.lax.bitcast_convert_type(start, jnp.uint32)]
-        _compact_write(BR, mA, valsA, [oA0, oA1, oA2], carr, 4, tails, 0,
-                       [bufA0, bufA1, bufA2], sems, 0, interpret)
-        valsB = [idx_u - jnp.uint32(na)]
-        _compact_write(BR, ib, valsB, [oB0], carr, 5, tails, 3,
-                       [bufB0], sems, 3, interpret)
+                 jax.lax.bitcast_convert_type(start, jnp.uint32)] \
+            + lane_vals[:La]
+        _compact_write(BR, mA, valsA, list(outsA), carr, 4, tails, 0,
+                       bufsA, sems, 0, interpret)
+        valsB = [idx_u - jnp.uint32(na)] + lane_vals[:Lb]
+        _compact_write(BR, ib, valsB, list(outsB), carr, 5, tails, nA,
+                       bufsB, sems, nA, interpret)
 
         @pl.when(i == pl.num_programs(0) - 1)
         def _():
@@ -400,18 +438,178 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
         out_shape=out_shapes,
         grid=(blocks,),
         in_specs=[pl.BlockSpec((BR, LANES), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM)] * 2,
-        out_specs=([pl.BlockSpec(memory_space=pl.ANY)] * 4
+                               memory_space=pltpu.VMEM)] * (2 + L),
+        out_specs=([pl.BlockSpec(memory_space=pl.ANY)] * (nA + nB)
                    + [pl.BlockSpec(memory_space=pltpu.SMEM)]),
         scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
         interpret=interpret,
-    )(b2, t2)
-    elist = res[0].reshape(-1)[:rows_a * LANES]
-    delc = res[1].reshape(-1)[:rows_a * LANES]
-    startsc = res[2].reshape(-1)[:rows_a * LANES]
-    blist = res[3].reshape(-1)[:rows_b * LANES]
-    return res[4], elist, delc, startsc, blist
+    )
+    with _x32_trace():
+        res = res(b2, t2, *l2)
+    return res[nA + nB], tuple(res[:nA]), tuple(res[nA:nA + nB])
+
+
+# ---------------------------------------------------------------------------
+# join_expand_stream — the streaming join materializer
+# ---------------------------------------------------------------------------
+
+
+def join_expand_stream(counts: jnp.ndarray,
+                       a_streams: Sequence[jnp.ndarray],
+                       b_streams: Sequence[jnp.ndarray],
+                       cap_e: int, block_rows: int = 64,
+                       interpret: bool = False):
+    """Expand a compacted join plan into the output rows — the streaming
+    replacement for the XLA scatter+cumsum+row-gather chain that dominated
+    the join at ~30 ns/row (profiled: ordx 228 ms + two output-sized row
+    gathers ~1.1 s at 17M output rows on v5e).
+
+    The key structural facts the kernel exploits:
+      * group A's output starts are STRICTLY increasing over emitting
+        runs, so the covering-run ordinal of output j is monotone — each
+        output block needs only a (BR+8)-row window of group A at the
+        carried run pointer, searched with `inverse_monotone`;
+      * within a run, b positions are CONSECUTIVE (bpos = j + delta), and
+        run lo's are non-decreasing, so each block's b reads live in a
+        short span walked with a windowed loop whose TOTAL work across
+        blocks is bounded by one streaming pass over group B (plus one
+        window per duplicate-key reset).
+
+    counts: i32[4] from join_plan_stream. a_streams: (elist, delc,
+    startsc, a_lane…); b_streams: (blist, b_lane…) — padded (rows, LANES)
+    u32 blocks as returned by join_plan_stream. cap_e: static output
+    capacity, must be a multiple of block_rows*LANES.
+
+    Returns (aidx, bidx, a_lane_outs, b_lane_outs): i32/u32 (cap_e,)
+    arrays; aidx = −1 beyond n_out, bidx = −1 where the row has no build
+    match; lanes are zeroed where their side's index is −1.
+    """
+    BR = block_rows
+    assert BR % 8 == 0 and BR >= 8
+    assert cap_e % (BR * LANES) == 0 and cap_e > 0
+    nA, nB = len(a_streams), len(b_streams)
+    La, Lb = nA - 3, nB - 1
+    nblocks = cap_e // (BR * LANES)
+    W = BR + 8  # window rows; DMA row counts must be multiples of 8
+    tot_a = a_streams[0].shape[0]
+    tot_b = b_streams[0].shape[0]
+    assert tot_a >= W and tot_b >= W, "plan streams carry BR+8 slack rows"
+
+    out_shapes = ([jax.ShapeDtypeStruct((nblocks * BR, LANES), jnp.int32)] * 2
+                  + [jax.ShapeDtypeStruct((nblocks * BR, LANES), jnp.uint32)]
+                  * (La + Lb))
+
+    scratch = ([pltpu.SMEM((2,), jnp.int32)]
+               + [pltpu.VMEM((W, LANES), jnp.uint32)
+                  for _ in range(nA + nB)]
+               + [pltpu.SemaphoreType.DMA((nA + nB,))])
+
+    def kernel(cnt_ref, *rest):
+        a_refs = rest[:nA]
+        b_refs = rest[nA:nA + nB]
+        o_aidx = rest[nA + nB]
+        o_bidx = rest[nA + nB + 1]
+        o_alane = rest[nA + nB + 2:nA + nB + 2 + La]
+        o_blane = rest[nA + nB + 2 + La:nA + nB + 2 + La + Lb]
+        carr = rest[nA + nB + 2 + La + Lb]
+        bufsA = list(rest[nA + nB + 3 + La + Lb:
+                          nA + nB + 3 + La + Lb + nA])
+        bufsB = list(rest[nA + nB + 3 + La + Lb + nA:
+                          nA + nB + 3 + La + Lb + nA + nB])
+        sems = rest[nA + nB + 3 + La + Lb + nA + nB]
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            carr[0] = 0  # run pointer: ordinal of prev block's last output
+
+        n_out = cnt_ref[0]
+        n_emit = cnt_ref[1]
+
+        # --- group A window at the carried run pointer ---
+        arow0 = jnp.minimum(carr[0] // LANES, tot_a - W)
+        for k in range(nA):
+            pltpu.make_async_copy(a_refs[k].at[pl.ds(arow0, W)], bufsA[k],
+                                  sems.at[k]).start()
+        for k in range(nA):
+            pltpu.make_async_copy(a_refs[k].at[pl.ds(arow0, W)], bufsA[k],
+                                  sems.at[k]).wait()
+        base_e = arow0 * LANES
+        ge = base_e + flat_iota((W, LANES))
+        s_raw = jax.lax.bitcast_convert_type(bufsA[2][:], jnp.int32)
+        s_win = jnp.where(ge < n_emit, s_raw, _I32MAX)
+        j = i * (BR * LANES) + flat_iota((BR, LANES))
+        # ordinal = #{r global : start[r] <= j} − 1; every pre-window run
+        # starts at/before the carried pointer's covered output, so the
+        # window count + base_e is the global count
+        cnt_le = inverse_monotone(s_win, j)
+        ordinal = base_e + cnt_le - 1
+        woff = jnp.maximum(cnt_le - 1, 0)
+        d2 = sweep_gather(
+            jax.lax.bitcast_convert_type(bufsA[1][:], jnp.int32), woff)
+        aidx = sweep_gather(
+            jax.lax.bitcast_convert_type(bufsA[0][:], jnp.int32), woff)
+        alanes = [sweep_gather(bufsA[3 + k][:], woff) for k in range(La)]
+        valid = j < n_out
+        has = ((d2 & 1) == 1) & valid
+        bpos = j + (d2 >> 1)  # arithmetic shift: delta may be negative
+        carr[0] = jnp.maximum(ordinal[BR - 1, LANES - 1], 0)
+
+        # --- group B windowed walk over the block's bpos span ---
+        bposv = jnp.where(has, bpos, _I32MAX)
+        minb = jnp.min(bposv)
+        maxb = jnp.max(jnp.where(has, bpos, -1))
+        brow0 = jnp.clip(minb // LANES, 0, tot_b - W)
+        nw = jnp.where(maxb >= 0,
+                       (jnp.minimum(maxb // LANES, tot_b - 1) - brow0) // W
+                       + 1, 0)
+        outs0 = tuple(jnp.zeros((BR, LANES), jnp.uint32)
+                      for _ in range(nB))
+
+        def body(w, outs):
+            brow = jnp.minimum(brow0 + w * W, tot_b - W)
+            for k in range(nB):
+                pltpu.make_async_copy(b_refs[k].at[pl.ds(brow, W)],
+                                      bufsB[k], sems.at[nA + k]).start()
+            for k in range(nB):
+                pltpu.make_async_copy(b_refs[k].at[pl.ds(brow, W)],
+                                      bufsB[k], sems.at[nA + k]).wait()
+            off = bpos - brow * LANES
+            inwin = has & (off >= 0) & (off < W * LANES)
+            return tuple(
+                jnp.where(inwin, sweep_gather(bufsB[k][:],
+                                              jnp.where(inwin, off, -1)),
+                          outs[k])
+                for k in range(nB))
+
+        outs = jax.lax.fori_loop(0, nw, body, outs0)
+
+        o_aidx[:] = jnp.where(valid, aidx, -1)
+        o_bidx[:] = jnp.where(
+            has, jax.lax.bitcast_convert_type(outs[0], jnp.int32), -1)
+        for k in range(La):
+            o_alane[k][:] = jnp.where(valid, alanes[k], jnp.uint32(0))
+        for k in range(Lb):
+            o_blane[k][:] = jnp.where(has, outs[1 + k], jnp.uint32(0))
+
+    res = pl.pallas_call(
+        kernel,
+        out_shape=out_shapes,
+        grid=(nblocks,),
+        in_specs=([pl.BlockSpec(memory_space=pltpu.SMEM)]
+                  + [pl.BlockSpec(memory_space=pl.ANY)] * (nA + nB)),
+        out_specs=[pl.BlockSpec((BR, LANES), lambda i: (i, 0))] * len(
+            out_shapes),
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )
+    with _x32_trace():
+        res = res(counts, *a_streams, *b_streams)
+    flat = [r.reshape(-1) for r in res]
+    return (flat[0], flat[1], tuple(flat[2:2 + La]),
+            tuple(flat[2 + La:2 + La + Lb]))
 
 
 def _compact_write(BR, m, vals, out_refs, wptr, wslot, tails, trow0,

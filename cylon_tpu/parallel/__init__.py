@@ -9,11 +9,12 @@ from .dist_ops import (distributed_groupby, distributed_join,
                        distributed_set_op, distributed_sort, hash_partition,
                        repartition)
 from .dist_ops import shuffle as shuffle_table
-from .shard import distribute, is_distributed_table, row_sharding
+from .shard import (distribute, distribute_by_key, is_distributed_table,
+                    row_sharding)
 
 __all__ = [
-    "dist_ops", "distribute", "distributed_groupby", "distributed_join",
-    "distributed_set_op", "distributed_sort", "hash_partition",
-    "is_distributed_table", "repartition", "row_sharding", "shard",
-    "shuffle", "shuffle_table",
+    "dist_ops", "distribute", "distribute_by_key", "distributed_groupby",
+    "distributed_join", "distributed_set_op", "distributed_sort",
+    "hash_partition", "is_distributed_table", "repartition", "row_sharding",
+    "shard", "shuffle", "shuffle_table",
 ]

@@ -186,13 +186,19 @@ def _groupby_fn(mesh, ops: Tuple[_groupby.AggregationOp, ...]):
 
 def shuffle(table: Table, hash_columns: Sequence) -> Table:
     """Repartition rows by key hash (reference: cylon::Shuffle,
-    table.cpp:162-236)."""
+    table.cpp:162-236). Tables already hash-placed on the same keys
+    (a previous shuffle, or shard.distribute_by_key host ingest) pass
+    through without an exchange."""
     ctx = table._ctx
     world = ctx.get_world_size()
     if world == 1:
         return table
     t = shard.distribute(table, ctx)
     idxs = [t._col_index(c) for c in hash_columns]
+    sig = shard.partition_signature([t._columns[i] for i in idxs], idxs,
+                                    world)
+    if sig is not None and t._hash_partitioned == sig:
+        return t
     targets = shard.pin(_hash.partition_targets(
         [t._columns[i] for i in idxs], world), ctx)
     emit = shard.pin(t.emit_mask(), ctx)
@@ -201,6 +207,7 @@ def shuffle(table: Table, hash_columns: Sequence) -> Table:
     dat, val = _payload_tuples(out, t.column_count)
     cols = _rebuild_columns(dat, val, t, t.column_names)
     result = Table(cols, ctx, new_emit)
+    result._hash_partitioned = sig
     # reference parity: Shuffle frees non-retained inputs (table.cpp:207)
     table._free_if_unretained()
     return result
@@ -209,14 +216,25 @@ def shuffle(table: Table, hash_columns: Sequence) -> Table:
 def hash_partition(table: Table, hash_columns: Sequence,
                    num_partitions: int) -> dict:
     """Split into a {partition_id: Table} map (reference: HashPartition,
-    table.hpp:354, table.cpp:102-160)."""
+    table.hpp:354, table.cpp:102-160 — C++ kernels there, the native host
+    partitioner here: the result is host-resident per-partition tables,
+    so one ct_row_hash + stable bucket order replaces num_partitions
+    device filter passes)."""
+    from ..data.column import Column
+
     idxs = [table._col_index(c) for c in hash_columns]
     t = table.compact()
-    targets = np.asarray(jax.device_get(_hash.partition_targets(
-        [t._columns[i] for i in idxs], num_partitions)))
+    host, valids, counts, order, offs = shard.host_partition_arrays(
+        t, idxs, num_partitions)
     out = {}
     for p in range(num_partitions):
-        out[p] = t.filter_mask(jnp.asarray(targets == p))
+        seg = order[offs[p]:offs[p + 1]]
+        cols = []
+        for ci, c in enumerate(t._columns):
+            v = None if valids[ci] is None else jnp.asarray(valids[ci][seg])
+            cols.append(Column(jnp.asarray(host[ci][seg]), c.dtype, v,
+                               c.dictionary, c.name))
+        out[p] = Table(cols, t._ctx)
     return out
 
 
@@ -255,10 +273,21 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig
     seq = ctx.get_next_sequence()
     shuffled = []
     with _phase("distributed_join.shuffle", seq):
-        for t, kcols in ((left_d, lcols), (right_d, rcols)):
-            targets = shard.pin(_hash.partition_targets(kcols, world), ctx)
+        for t, kcols, kidx in ((left_d, lcols, lidx), (right_d, rcols, ridx)):
             bits = _order.sort_keys(kcols)
             kv = _all_valid(kcols)
+            sig = shard.partition_signature(kcols, kidx, world)
+            if sig is not None and t._hash_partitioned == sig:
+                # co-partitioned (prior shuffle or distribute_by_key host
+                # ingest): rows are already hash-placed — skip the exchange
+                dat = tuple(shard.pin(c.data, ctx) for c in t._columns)
+                val = tuple(shard.pin(c.valid_mask(), ctx)
+                            for c in t._columns)
+                shuffled.append((tuple(shard.pin(b, ctx) for b in bits),
+                                 shard.pin(kv, ctx),
+                                 shard.pin(t.emit_mask(), ctx), dat, val))
+                continue
+            targets = shard.pin(_hash.partition_targets(kcols, world), ctx)
             payload = _table_payload(t)
             for j, b in enumerate(bits):
                 payload[f"k{j}"] = b

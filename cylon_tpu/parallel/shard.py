@@ -112,6 +112,81 @@ def distribute_array(arr, n_src_rows: int, ctx: CylonContext,
                           row_sharding(ctx))
 
 
+def partition_signature(key_cols, idxs, world: int):
+    """Hashable co-partitioning witness: a table whose rows were placed
+    by hash of these key columns can skip a later shuffle on the same
+    keys — but only when the key dtypes at join time match the dtypes
+    hashed at placement time (align_key_columns may promote), and never
+    for strings (vocabulary unification re-codes them)."""
+    if any(c.is_string for c in key_cols):
+        return None
+    return (tuple(int(i) for i in idxs),
+            tuple(str(c.data.dtype) for c in key_cols), int(world))
+
+
+def host_partition_arrays(t: Table, idxs, world: int):
+    """Shared host-side partition preamble: pull a COMPACTED table's
+    columns to host, run the native partitioner over its key columns,
+    and return (host_cols, valids, counts, order, offsets). Used by both
+    distribute_by_key and dist_ops.hash_partition so placement logic
+    lives in exactly one place."""
+    from .. import native as _native
+
+    host = [np.asarray(jax.device_get(c.data)) for c in t._columns]
+    valids = [None if c.validity is None
+              else np.asarray(jax.device_get(c.valid_mask()))
+              for c in t._columns]
+    flags = [t._columns[i].is_string for i in idxs]
+    _targets, counts, order = _native.hash_partition(
+        [host[i] for i in idxs], [valids[i] for i in idxs], world,
+        is_string=flags)
+    offs = np.concatenate([[0], np.cumsum(counts)])
+    return host, valids, counts, order, offs
+
+
+def distribute_by_key(table: Table, ctx: CylonContext, key_columns) -> Table:
+    """Host-side pre-partitioned ingest: place every row on the shard its
+    key HASHES to (the placement a device shuffle would produce), using
+    the native partitioner (native/cylon_host.cpp ct_row_hash /
+    ct_partition_order — bit-identical to ops/hash.partition_targets).
+
+    The result carries a co-partitioning witness, so `shuffle` on the
+    same keys is a no-op and `distributed_join` skips that side's
+    exchange — the ingest-time analog of the reference shuffling inside
+    DistributedJoin (table.cpp:656-696), moved off the device entirely.
+    """
+    world = ctx.get_world_size()
+    idxs = [table._col_index(c) for c in key_columns]
+    t = table.compact()
+    key_cols = [t._columns[i] for i in idxs]
+    host, valids, counts, order, offs = host_partition_arrays(t, idxs, world)
+
+    cap = shard_capacity(int(counts.max()), 1)
+    total = world * cap
+    sharding = row_sharding(ctx)
+
+    def build(arr, fill, dtype=None):
+        a = np.asarray(arr)
+        g = a[order]
+        out = np.full((total,) + a.shape[1:], fill,
+                      a.dtype if dtype is None else dtype)
+        for s in range(world):
+            out[s * cap:s * cap + counts[s]] = g[offs[s]:offs[s + 1]]
+        return jax.device_put(jnp.asarray(out), sharding)
+
+    cols = []
+    for ci, c in enumerate(t._columns):
+        data = build(host[ci], 0)
+        validity = None if valids[ci] is None else build(valids[ci], False)
+        cols.append(Column(data, c.dtype, validity, c.dictionary, c.name))
+    emit = np.zeros(total, np.bool_)
+    for s in range(world):
+        emit[s * cap:s * cap + counts[s]] = True
+    out = Table(cols, ctx, jax.device_put(jnp.asarray(emit), sharding))
+    out._hash_partitioned = partition_signature(key_cols, idxs, world)
+    return out
+
+
 def assemble_process_local(tables, ctx: CylonContext) -> Table:
     """Build ONE global distributed Table from per-shard host tables, one
     per shard this process owns (the multi-host ingest path: the

@@ -1,0 +1,11 @@
+#!/bin/sh
+# Build the native host runtime (native/cylon_host.cpp) into
+# cylon_tpu/_native/libcylon_host.so. cylon_tpu.native also does this
+# lazily on first use; this script exists for CI / explicit builds.
+set -e
+here="$(cd "$(dirname "$0")/.." && pwd)"
+mkdir -p "$here/cylon_tpu/_native"
+${CXX:-g++} -O3 -std=c++17 -shared -fPIC -pthread \
+    -o "$here/cylon_tpu/_native/libcylon_host.so" \
+    "$here/native/cylon_host.cpp"
+echo "built $here/cylon_tpu/_native/libcylon_host.so"

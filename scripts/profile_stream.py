@@ -1,4 +1,8 @@
-"""Phase profile of the streaming join path at bench shapes."""
+"""Phase profile of the streaming join path at bench shapes.
+
+NOTE: on the tunneled axon platform `jax.block_until_ready` does not
+block; phases are synced by device_get of one element of their outputs.
+"""
 import time
 
 import numpy as np
@@ -7,61 +11,60 @@ import jax.numpy as jnp
 
 from cylon_tpu.ops import join as _join
 from cylon_tpu.ops import tpu_kernels as tk
-from cylon_tpu.util import capacity
+
+
+def sync(r):
+    leaf = [x for x in jax.tree_util.tree_leaves(r)
+            if hasattr(x, "ravel")][-1]
+    jax.device_get(leaf.ravel()[:1])
 
 
 def timeit(fn, iters=3):
-    jax.block_until_ready(fn())
+    sync(fn())
     ts = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        sync(fn())
         ts.append(time.perf_counter() - t0)
     return min(ts)
 
 
-def main():
-    n = 1 << 24
+def main(n=1 << 24):
     rng = np.random.default_rng(0)
     lk = jnp.asarray(rng.integers(0, n, n).astype(np.int32))
     lv = jnp.asarray(rng.normal(size=n).astype(np.float32))
     rk = jnp.asarray(rng.integers(0, n, n).astype(np.int32))
     rv = jnp.asarray(rng.normal(size=n).astype(np.float32))
-    none1 = (None,)
+    ldat, lval = (lk, lv), (None, None)
+    rdat, rval = (rk, rv), (None, None)
+    jt = _join.JoinType.INNER
+    a_desc, b_desc = _join.plan_lane_descs(ldat, lval, rdat, rval, jt)
+    br = _join.stream_block_rows(n, n)
 
-    t_plan = timeit(lambda: _join.plan_program_stream(
-        (lk,), none1, None, (rk,), none1, None, (False,),
-        _join.JoinType.INNER, interpret=False))
-    res = _join.plan_program_stream((lk,), none1, None, (rk,), none1, None,
-                                    (False,), _join.JoinType.INNER,
-                                    interpret=False)
-    counts, elist, delc, startsc, blist = res
-    n_out = int(jax.device_get(counts)[0])
-    cap = capacity(n_out)
-    print(f"plan_stream total: {t_plan*1e3:.1f} ms  n_out={n_out}")
+    def plan():
+        return _join.plan_program_stream(
+            (lk,), (None,), None, (rk,), (None,), None,
+            ldat, lval, rdat, rval, (False,), jt,
+            a_desc=a_desc, b_desc=b_desc, block_rows=br)
 
-    # sort alone — with the REAL tag encoding (side<<31|emit<<30|live<<29)
-    # so the kernel below sees live rows, not an all-inert stream
-    bits = jnp.concatenate([lk.view(jnp.uint32) ^ jnp.uint32(1 << 31),
-                            rk.view(jnp.uint32) ^ jnp.uint32(1 << 31)])
-    iota = jnp.arange(2 * n, dtype=jnp.uint32)
-    tag = (jnp.where(iota < n, jnp.uint32(1 << 31), jnp.uint32(0))
-           | jnp.uint32(3 << 29) | iota)
-    srt = jax.jit(lambda a, b: jax.lax.sort((a, b), num_keys=2))
-    t_sort = timeit(lambda: srt(bits, tag))
-    print(f"  sort alone: {t_sort*1e3:.1f} ms")
+    t_plan = timeit(plan)
+    counts, a_streams, b_streams = plan()
+    n_primary = int(jax.device_get(counts)[0])
+    cap_e = _join.stream_expand_capacity(n_primary, br)
+    print(f"plan         {t_plan * 1e3:9.1f} ms   n_out={n_primary}")
 
-    bs, ts_ = srt(bits, tag)
-    kern = jax.jit(lambda b, t: tk.join_plan_stream(
-        b, t, n, n, emit_unmatched_a=False))
-    t_kern = timeit(lambda: kern(bs, ts_))
-    print(f"  pallas pass alone: {t_kern*1e3:.1f} ms")
+    def mat():
+        return _join.materialize_program_stream(
+            counts, a_streams, b_streams, ldat, lval, rdat, rval,
+            jt, cap_e, a_desc=a_desc, b_desc=b_desc, block_rows=br)
 
-    t_mat = timeit(lambda: _join.materialize_program_stream(
-        counts, elist, delc, startsc, blist,
-        (lk, lv), (None, None), (rk, rv), (None, None),
-        _join.JoinType.INNER, cap))
-    print(f"materialize_stream: {t_mat*1e3:.1f} ms")
+    print(f"materialize  {timeit(mat) * 1e3:9.1f} ms   cap_e={cap_e}")
+
+    def expand():
+        return tk.join_expand_stream(counts, a_streams, b_streams, cap_e,
+                                     block_rows=br)
+
+    print(f"  expand jit {timeit(jax.jit(expand)) * 1e3:9.1f} ms")
 
 
 if __name__ == "__main__":

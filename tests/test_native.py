@@ -1,0 +1,173 @@
+"""Native host runtime (native/cylon_host.cpp via cylon_tpu.native):
+bit-parity with the device kernels, CSV writer round-trip, bitmap codec,
+staging pool. The library builds lazily with the system g++; tests skip
+if no compiler is available (the numpy fallbacks are still exercised via
+the public APIs elsewhere)."""
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import native
+
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="no C++ toolchain")
+
+
+@pytest.fixture
+def ctx():
+    return ct.CylonContext.Init()
+
+
+def test_row_hash_matches_device(ctx):
+    """Host ct_row_hash == device ops/hash.hash_columns, bit for bit —
+    the invariant that makes host ingest placement agree with device
+    shuffle placement."""
+    from cylon_tpu.data.column import Column
+    from cylon_tpu.ops import hash as dev_hash
+
+    rng = np.random.default_rng(0)
+    n = 5000
+    i32 = rng.integers(-1000, 1000, n).astype(np.int32)
+    i64 = rng.integers(-(1 << 40), 1 << 40, n).astype(np.int64)
+    f32 = rng.normal(size=n).astype(np.float32)
+    f32[::7] = -0.0  # normalization edge
+    vmask = rng.random(n) > 0.1
+
+    cols = [Column.from_numpy(i32), Column.from_numpy(i64),
+            Column.from_numpy(f32, validity=vmask)]
+    want = np.asarray(dev_hash.hash_columns(cols))
+    got = native.row_hash([i32, i64, f32], [None, None, vmask])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_hash_partition_matches_device(ctx):
+    from cylon_tpu.data.column import Column
+    from cylon_tpu.ops import hash as dev_hash
+
+    rng = np.random.default_rng(1)
+    n, world = 20000, 8
+    k = rng.integers(0, 500, n).astype(np.int32)
+    want = np.asarray(dev_hash.partition_targets(
+        [Column.from_numpy(k)], world))
+    targets, counts, order = native.hash_partition([k], [None], world)
+    np.testing.assert_array_equal(targets, want)
+    assert counts.sum() == n
+    np.testing.assert_array_equal(
+        counts, np.bincount(targets, minlength=world))
+    # order groups rows stably by target
+    gathered = targets[order]
+    assert (np.diff(gathered) >= 0).all()
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    for t in range(world):
+        seg = order[starts[t]:starts[t] + counts[t]]
+        assert (np.diff(seg) > 0).all()  # stable = increasing within target
+
+
+def test_bitmap_roundtrip():
+    rng = np.random.default_rng(2)
+    for n in (0, 1, 7, 8, 9, 1000):
+        m = rng.random(n) > 0.5
+        bits = native.pack_bitmap(m)
+        assert len(bits) == (n + 7) // 8
+        back = native.unpack_bitmap(bits, n)
+        np.testing.assert_array_equal(back, m)
+
+
+def test_bitmap_matches_pyarrow():
+    import pyarrow as pa
+
+    rng = np.random.default_rng(3)
+    n = 999
+    m = rng.random(n) > 0.3
+    arr = pa.array(np.arange(n), mask=~m)
+    pa_bits = np.frombuffer(arr.buffers()[0], dtype=np.uint8)
+    ours = native.pack_bitmap(m)
+    np.testing.assert_array_equal(ours, pa_bits[:len(ours)])
+
+
+@needs_native
+def test_native_csv_writer_roundtrip(ctx, tmp_path):
+    import pandas as pd
+
+    rng = np.random.default_rng(4)
+    n = 3000
+    vmask = rng.random(n) > 0.2
+    t = ct.Table.from_pydict(ctx, {
+        "a": rng.integers(-(1 << 30), 1 << 30, n).astype(np.int32),
+        "b": rng.integers(-(1 << 60), 1 << 60, n).astype(np.int64),
+        "c": rng.normal(size=n).astype(np.float32),
+        "d": rng.normal(size=n).astype(np.float64),
+    })
+    # null some floats through the pandas NaN path
+    df_in = t.to_pandas()
+    df_in.loc[~vmask, "d"] = np.nan
+    t = ct.Table.from_pandas(ctx, df_in)
+
+    p = tmp_path / "out.csv"
+    t.to_csv(str(p))
+    back = pd.read_csv(p)
+    ref = t.to_pandas()
+    assert list(back.columns) == list(ref.columns)
+    np.testing.assert_array_equal(back["a"].to_numpy(), ref["a"].to_numpy())
+    np.testing.assert_array_equal(back["b"].to_numpy(), ref["b"].to_numpy())
+    np.testing.assert_allclose(back["c"].to_numpy(),
+                               ref["c"].to_numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(np.isnan(back["d"].to_numpy()), ~vmask)
+    np.testing.assert_allclose(back["d"].to_numpy()[vmask],
+                               ref["d"].to_numpy()[vmask])
+
+
+@needs_native
+def test_native_csv_writer_padded_table(ctx, tmp_path):
+    import pandas as pd
+
+    t = ct.Table.from_pydict(ctx, {
+        "k": np.arange(100, dtype=np.int32),
+        "v": np.arange(100, dtype=np.float32)})
+    f = t.filter_mask(t.get_column(0).data % 3 == 0)  # padded row_mask
+    p = tmp_path / "f.csv"
+    f.to_csv(str(p))
+    back = pd.read_csv(p)
+    assert len(back) == f.row_count
+    np.testing.assert_array_equal(back["k"].to_numpy(),
+                                  np.arange(0, 100, 3, dtype=np.int64))
+
+
+@needs_native
+def test_staging_pool_reuse():
+    pool = native.StagingPool()
+    a = pool.take(1 << 16)
+    assert a is not None and a.nbytes >= 1 << 16
+    a[:8] = np.arange(8, dtype=np.uint8)
+    addr = getattr(a, "_ct_pool_addr", 0)
+    pool.give(a)
+    b = pool.take(1 << 16)
+    assert getattr(b, "_ct_pool_addr", 0) == addr  # reused, not realloc'd
+    live, free = pool.stats()
+    assert live >= 1 << 16
+    pool.give(b)
+
+
+def test_available_reports():
+    # wherever a C++ compiler exists the native path must load; without
+    # one the module must still answer (False) instead of raising
+    import shutil
+
+    got = native.available()
+    if any(shutil.which(c) for c in ("g++", "c++", "clang++")):
+        assert got is True
+    else:
+        assert got is False
+
+
+def test_native_csv_writer_rejects_bad_args(ctx, tmp_path):
+    # mismatched names length must fall back (return False), never crash
+    cols = [np.arange(5, dtype=np.int32), np.arange(5, dtype=np.float64)]
+    ok = native.write_csv_numeric(cols, [None, None], ["one"],
+                                  str(tmp_path / "x.csv"))
+    assert ok is False
+    # multi-byte separators likewise
+    ok = native.write_csv_numeric(cols, [None, None], ["a", "b"],
+                                  str(tmp_path / "y.csv"), sep="¦")
+    assert ok is False

@@ -1,0 +1,115 @@
+"""Host-side pre-partitioned ingest (shard.distribute_by_key, native
+partitioner) and the co-partitioning fast paths: shuffle no-op and
+distributed_join exchange skip."""
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.parallel import dist_ops, shard
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return ct.CylonContext.InitDistributed(ct.TPUConfig(world_size=4))
+
+
+def _mk(ctx, n, hi, seed, vcol="v"):
+    rng = np.random.default_rng(seed)
+    return ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, hi, n).astype(np.int32),
+        vcol: rng.integers(0, 1000, n).astype(np.int32),
+    })
+
+
+def test_distribute_by_key_placement_matches_device(ctx):
+    """Rows land on the shard the DEVICE hash would send them to."""
+    from cylon_tpu.ops import hash as dev_hash
+
+    t = _mk(ctx, 500, 40, 0)
+    world = ctx.get_world_size()
+    want = np.asarray(dev_hash.partition_targets([t.get_column(0)], world))
+    d = shard.distribute_by_key(t, ctx, ["k"])
+    cap = d.capacity // world
+    import jax
+
+    k = np.asarray(jax.device_get(d.get_column(0).data))
+    emit = np.asarray(jax.device_get(d.emit_mask()))
+    tgt = {}
+    for s in range(world):
+        for v in k[s * cap:(s + 1) * cap][emit[s * cap:(s + 1) * cap]]:
+            tgt.setdefault(int(v), set()).add(s)
+    # every key value lives on exactly its hash shard
+    host_k = np.asarray(jax.device_get(t.get_column(0).data))
+    for v, shards in tgt.items():
+        expect = {int(w) for kv, w in zip(host_k, want) if kv == v}
+        assert shards == expect
+
+
+def test_shuffle_skips_for_copartitioned(ctx):
+    t = _mk(ctx, 300, 30, 1)
+    d = shard.distribute_by_key(t, ctx, ["k"])
+    out = dist_ops.shuffle(d, ["k"])
+    assert out is d  # no exchange happened
+    # and a device shuffle's own output is likewise marked
+    s1 = dist_ops.shuffle(shard.distribute(t, ctx), ["k"])
+    s2 = dist_ops.shuffle(s1, ["k"])
+    assert s2 is s1
+
+
+def test_join_on_prepartitioned_matches_plain(ctx):
+    left = _mk(ctx, 400, 50, 2, "v")
+    right = _mk(ctx, 300, 50, 3, "w")
+    ref = left.distributed_join(right, "inner", on="k")
+
+    lp = shard.distribute_by_key(left, ctx, ["k"])
+    rp = shard.distribute_by_key(right, ctx, ["k"])
+    got = lp.distributed_join(rp, "inner", on="k")
+
+    from collections import Counter
+
+    def rows(t):
+        d = t.to_pydict()
+        return Counter(zip(*d.values()))
+
+    assert rows(got) == rows(ref)
+
+
+def test_join_mixed_prepartitioned_one_side(ctx):
+    left = _mk(ctx, 400, 50, 4, "v")
+    right = _mk(ctx, 300, 50, 5, "w")
+    ref = left.distributed_join(right, "left", on="k")
+    lp = shard.distribute_by_key(left, ctx, ["k"])
+    got = lp.distributed_join(right, "left", on="k")
+
+    from collections import Counter
+
+    def rows(t):
+        d = t.to_pydict()
+        return Counter(zip(*d.values()))
+
+    assert rows(got) == rows(ref)
+
+
+def test_distribute_by_key_nulls_and_floats(ctx):
+    import pandas as pd
+
+    rng = np.random.default_rng(6)
+    n = 200
+    k = rng.normal(size=n).astype(np.float32)
+    k[rng.random(n) < 0.2] = np.nan
+    t = ct.Table.from_pandas(ctx, pd.DataFrame({
+        "k": k, "v": np.arange(n, dtype=np.int32)}))
+    d = shard.distribute_by_key(t, ctx, ["k"])
+    assert d.row_count == n
+    ref = t.distributed_join(t, "inner", on="k")
+    got = d.distributed_join(d, "inner", on="k")
+    assert got.row_count == ref.row_count
+
+
+def test_signature_guards():
+    """Strings never produce a signature (vocab re-coding breaks hash
+    stability across tables)."""
+    from cylon_tpu.data.column import Column
+
+    c = Column.from_numpy(np.array(["a", "b"]))
+    assert shard.partition_signature([c], [0], 4) is None
