@@ -327,13 +327,15 @@ class Table:
     # ------------------------------------------------------------------
 
     def join(self, table: "Table", join_type: str = "inner",
-             algorithm: str = "sort", **kwargs) -> "Table":
-        """Local join; self is the LEFT table (pycylon table.pyx:373-390)."""
+             algorithm: str = "auto", **kwargs) -> "Table":
+        """Local join; self is the LEFT table (pycylon table.pyx:373-390).
+        algorithm: "auto" (default — fastest applicable path), "sort", or
+        "hash" (reference join_config.hpp:25)."""
         cfg = self._make_join_config(table, join_type, algorithm, kwargs)
         return join(self, table, cfg)
 
     def distributed_join(self, table: "Table", join_type: str = "inner",
-                         algorithm: str = "sort", **kwargs) -> "Table":
+                         algorithm: str = "auto", **kwargs) -> "Table":
         """comm="shuffle" (default) repartitions both sides via all-to-all;
         comm="ring" streams the build side around the mesh ring
         (ArrowJoin-style overlap, best for a small build side)."""
@@ -550,7 +552,8 @@ _JOIN_TYPES = {
 }
 
 _JOIN_ALGOS = {"sort": _join.JoinAlgorithm.SORT,
-               "hash": _join.JoinAlgorithm.HASH}
+               "hash": _join.JoinAlgorithm.HASH,
+               "auto": _join.JoinAlgorithm.AUTO}
 
 
 def _as_agg_op(o) -> _groupby.AggregationOp:
@@ -658,9 +661,22 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     rval = tuple(c.validity for c in right._columns)
 
     seq = left._ctx.get_next_sequence()
-    use_stream = _join.stream_plan_applicable(lkeys, rkeys, str_flags,
-                                              config.type)
-    if use_stream:
+    # route: the sort-stream path handles single 4-byte keys; the
+    # hash-stream path (JoinAlgorithm.HASH — reference hash join,
+    # arrow_hash_kernels.hpp:48-225) covers multi-column/wide keys by
+    # sorting a 2x32-bit row hash with exact collision fallback; the XLA
+    # plan is the general fallback (FULL_OUTER, forced, collisions).
+    alg = config.algorithm
+    use_stream = (alg != _join.JoinAlgorithm.HASH
+                  and _join.stream_plan_applicable(lkeys, rkeys, str_flags,
+                                                   config.type))
+    use_hash = (not use_stream
+                and alg in (_join.JoinAlgorithm.HASH,
+                            _join.JoinAlgorithm.AUTO)
+                and _join.hash_stream_applicable(lkeys, rkeys, str_flags,
+                                                 config.type))
+
+    def _stream_join(hash_mode: bool):
         interp = jax.default_backend() != "tpu"
         a_desc, b_desc = _join.plan_lane_descs(ldat, lval, rdat, rval,
                                                config.type)
@@ -670,19 +686,30 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
                 lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
                 ldat, lval, rdat, rval, str_flags, config.type,
                 a_desc=a_desc, b_desc=b_desc, block_rows=br,
-                interpret=interp)
-            n_primary = int(jax.device_get(counts)[0])
+                hash_mode=hash_mode, interpret=interp)
+            host_counts = jax.device_get(counts)
+            n_primary = int(host_counts[0])
+        if hash_mode and int(host_counts[3]) > 0:
+            return None  # hash collision — caller recomputes exactly
         if n_primary < 0:
             raise CylonError(Code.ExecutionError,
                              "join output exceeds 2^31 rows per shard; "
                              "repartition over more shards")
         cap_e = _join.stream_expand_capacity(n_primary, br)
         with _telemetry.phase("join.materialize", seq):
-            lod, lov, rod, rov, emit = _join.materialize_program_stream(
+            return _join.materialize_program_stream(
                 counts, a_streams, b_streams,
                 ldat, lval, rdat, rval, config.type, cap_e,
                 a_desc=a_desc, b_desc=b_desc, block_rows=br,
                 interpret=interp)
+
+    res = None
+    if use_stream:
+        res = _stream_join(hash_mode=False)
+    elif use_hash:
+        res = _stream_join(hash_mode=True)
+    if res is not None:
+        lod, lov, rod, rov, emit = res
     else:
         with _telemetry.phase("join.plan", seq):
             counts2, lo, m, bperm, un_mask = _join.plan_program(

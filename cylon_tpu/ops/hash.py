@@ -35,6 +35,19 @@ def fmix32(h: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
+def fmix32b(h: jnp.ndarray) -> jnp.ndarray:
+    """Second, independent 32-bit avalanche ("lowbias32" constants) — the
+    hash-join path pairs it with fmix32 so a row carries 2x32 independent
+    hash bits; a pair collision needs BOTH to collide (~2^-64 per pair),
+    and the plan kernel's verify lanes catch even those exactly."""
+    h = h ^ (h >> 16)
+    h = h * np.uint32(0x7FEB352D)
+    h = h ^ (h >> 15)
+    h = h * np.uint32(0x846CA68B)
+    h = h ^ (h >> 16)
+    return h
+
+
 def fmix64(h: jnp.ndarray) -> jnp.ndarray:
     """murmur3/splitmix 64-bit finalizer."""
     h = h ^ (h >> 33)

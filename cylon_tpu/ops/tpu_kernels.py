@@ -289,6 +289,8 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
                      lanes: Sequence[jnp.ndarray] = (),
                      n_a_lanes: Optional[int] = None,
                      n_b_lanes: Optional[int] = None,
+                     bits2_s: Optional[jnp.ndarray] = None,
+                     verify_lanes: Sequence[jnp.ndarray] = (),
                      block_rows: int = 64, interpret: bool = False):
     """ONE sequential pass over the key-sorted row stream that computes the
     whole join plan — the Pallas replacement for the XLA scatter/gather
@@ -303,6 +305,13 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
               a-side column s at a rows, b-side column s at b rows) —
               they are compacted into both groups so the expansion kernel
               never has to random-gather payload from HBM.
+      bits2_s: optional SECOND run-boundary stream — the hash-join path
+              sorts on a 2x32-bit row hash, so runs are (bits, bits2)
+              equality classes.
+      verify_lanes: u32 key-bit streams checked for equality WITHIN each
+              run; any difference between adjacent live rows bumps the
+              collision counter (counts[3]) — the hash-join path treats
+              a nonzero count as "hash collision, recompute exactly".
 
     Per element the pass derives, with SMEM carries across the sequential
     grid: the live-b prefix count (block_cumsum), run boundaries (shifted
@@ -315,11 +324,11 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
       group B (live build rows):     {orig index, payload lanes…} — the
               key-ordered build permutation (bperm analog).
 
-    Returns (counts i32[4] = [n_out, n_emit, n_blive, 0], a_streams,
-    b_streams) where a_streams = (elist, delc, startsc, a_lane…) and
-    b_streams = (blist, b_lane…), each a PADDED (rows, LANES) u32 block
-    array; entries beyond their count are garbage — consumers mask by the
-    counts (join_expand_stream).
+    Returns (counts i32[4] = [n_out, n_emit, n_blive, n_collisions],
+    a_streams, b_streams) where a_streams = (elist, delc, startsc,
+    a_lane…) and b_streams = (blist, b_lane…), each a PADDED (rows,
+    LANES) u32 block array; entries beyond their count are garbage —
+    consumers mask by the counts (join_expand_stream).
     """
     n = bits_s.shape[0]
     BR = block_rows
@@ -330,6 +339,8 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
     La = L if n_a_lanes is None else n_a_lanes
     Lb = L if n_b_lanes is None else n_b_lanes
     nA, nB = 3 + La, 1 + Lb
+    has_b2 = bits2_s is not None
+    nv = len(verify_lanes)
     assert BR % 8 == 0 and BR >= 8
     assert n < (1 << 29)
     blocks = max(-(-n // (BR * LANES)), 1)
@@ -337,6 +348,8 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
     allones = jnp.uint32(0xFFFFFFFF)
     b2 = pad_rows(bits_s, rows, fill=allones)
     t2 = pad_rows(tag_s, rows, fill=0)  # side=0, live=0 → inert
+    b2b = pad_rows(bits2_s, rows, fill=allones) if has_b2 else None
+    v2 = [pad_rows(x, rows, fill=0) for x in verify_lanes]
     l2 = [pad_rows(x, rows, fill=0) for x in lanes]
 
     rows_a = rows_for(max(na, 1))
@@ -349,22 +362,32 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
         + [jax.ShapeDtypeStruct((out_rows_b, LANES), jnp.uint32)] * nB
         + [jax.ShapeDtypeStruct((4,), jnp.int32)])
 
+    # tails rows: [0,nA) A partial-row carries, [nA,nA+nB) B carries,
+    # then prev-element carries: bits, tag, bits2?, verify lanes…
+    t_prev = nA + nB
+    n_tails = t_prev + 2 + (1 if has_b2 else 0) + nv
     scratch = ([pltpu.SMEM((8,), jnp.int32),
-                pltpu.VMEM((nA + nB + 1, LANES), jnp.uint32)]
+                pltpu.VMEM((n_tails, LANES), jnp.uint32)]
                + [pltpu.VMEM((BR + 8, LANES), jnp.uint32)
                   for _ in range(nA + nB)]
                + [pltpu.SemaphoreType.DMA((nA + nB,))])
 
     def kernel(bits_ref, tag_ref, *rest):
-        lane_refs = rest[:L]
-        outsA = rest[L:L + nA]
-        outsB = rest[L + nA:L + nA + nB]
-        cnt_ref = rest[L + nA + nB]
-        carr = rest[L + nA + nB + 1]
-        tails = rest[L + nA + nB + 2]
-        bufsA = list(rest[L + nA + nB + 3:L + nA + nB + 3 + nA])
-        bufsB = list(rest[L + nA + nB + 3 + nA:L + nA + nB + 3 + nA + nB])
-        sems = rest[L + nA + nB + 3 + nA + nB]
+        k = 0
+        bits2_ref = rest[k] if has_b2 else None
+        k += 1 if has_b2 else 0
+        vrefs = rest[k:k + nv]
+        k += nv
+        lane_refs = rest[k:k + L]
+        k += L
+        outsA = rest[k:k + nA]
+        outsB = rest[k + nA:k + nA + nB]
+        cnt_ref = rest[k + nA + nB]
+        carr = rest[k + nA + nB + 1]
+        tails = rest[k + nA + nB + 2]
+        bufsA = list(rest[k + nA + nB + 3:k + nA + nB + 3 + nA])
+        bufsB = list(rest[k + nA + nB + 3 + nA:k + nA + nB + 3 + nA + nB])
+        sems = rest[k + nA + nB + 3 + nA + nB]
         i = pl.program_id(0)
         bits = bits_ref[:]
         tag = tag_ref[:]
@@ -377,20 +400,46 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
             carr[2] = 0  # running max of head b_before (monotone ≥ 0)
             carr[4] = 0  # group A write pointer (n_emit)
             carr[5] = 0  # group B write pointer (n_blive)
-            tails[:] = jnp.zeros((nA + nB + 1, LANES), jnp.uint32)
+            carr[6] = 0  # within-run key-mismatch (hash collision) count
+            tails[:] = jnp.zeros((n_tails, LANES), jnp.uint32)
 
-        # prev-element bits carry lives in the LAST tails row (Mosaic has
-        # no scalar bitcast, so an SMEM i32 slot can't hold a u32 pattern);
+        def prev_of(x, trow, fill0):
+            """x shifted down by one in flat order, the vacated head
+            filled from the carried last element of the previous block
+            (prev-element carries live in tails rows — Mosaic has no
+            scalar bitcast, so an SMEM i32 slot can't hold a u32)."""
+            pf = jnp.where(i == 0, fill0, tails[trow, LANES - 1])
+            return flat_shift(x, jnp.int32(1), fill=pf,
+                              interpret=interpret)
+
         # at i==0 any value ≠ bits[0,0] forces the first run head
-        prev_fill = jnp.where(i == 0, bits[0, 0] + jnp.uint32(1),
-                              tails[nA + nB, LANES - 1])
-        pb = flat_shift(bits, jnp.int32(1), fill=prev_fill,
-                        interpret=interpret)
+        pb = prev_of(bits, t_prev, bits[0, 0] + jnp.uint32(1))
         neq = bits != pb
+        if has_b2:
+            bits2 = bits2_ref[:]
+            neq = neq | (bits2 != prev_of(bits2, t_prev + 2,
+                                          bits2[0, 0] + jnp.uint32(1)))
         side = (tag >> 31) == 1
         emit = ((tag >> 30) & 1) == 1
         live = ((tag >> 29) & 1) == 1
         idx_u = tag & jnp.uint32((1 << 29) - 1)
+
+        if nv:
+            # hash-collision audit: adjacent LIVE rows inside one run
+            # must agree on every true-key lane (prev tag carried for the
+            # cross-block boundary; tag fill 0 → prev dead → no flag)
+            ptag = prev_of(tag, t_prev + 1, jnp.uint32(0))
+            prev_live = ((ptag >> 29) & 1) == 1
+            coll = jnp.zeros(bits.shape, bool)
+            vbase = t_prev + 2 + (1 if has_b2 else 0)
+            for vi in range(nv):
+                vl = vrefs[vi][:]
+                coll = coll | (vl != prev_of(vl, vbase + vi, jnp.uint32(0)))
+            # a live row BELOW a dead row in one run means a live key
+            # hashed to the dead rows' forced all-ones slot — its verify
+            # chain is interrupted, so that also counts as a collision
+            coll = (coll | ~prev_live) & (~neq) & live
+            carr[6] = carr[6] + jnp.sum(coll.astype(jnp.int32))
 
         ib = ((~side) & live).astype(jnp.int32)
         cumb = block_cumsum(ib, interpret) + carr[0]
@@ -413,7 +462,13 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
         carr[0] = cumb[BR - 1, LANES - 1]
         carr[1] = offv[BR - 1, LANES - 1]
         carr[2] = bb[BR - 1, LANES - 1]
-        tails[nA + nB:nA + nB + 1, :] = bits[BR - 1:BR, :]
+        tails[t_prev:t_prev + 1, :] = bits[BR - 1:BR, :]
+        tails[t_prev + 1:t_prev + 2, :] = tag[BR - 1:BR, :]
+        if has_b2:
+            tails[t_prev + 2:t_prev + 3, :] = bits2[BR - 1:BR, :]
+        for vi in range(nv):
+            vb = t_prev + 2 + (1 if has_b2 else 0) + vi
+            tails[vb:vb + 1, :] = vrefs[vi][BR - 1:BR, :]
 
         mA = (mm > 0).astype(jnp.int32)
         valsA = [idx_u,
@@ -431,14 +486,15 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
             cnt_ref[0] = offv[BR - 1, LANES - 1]  # n_out
             cnt_ref[1] = carr[4]                  # n_emit
             cnt_ref[2] = carr[5]                  # n_blive
-            cnt_ref[3] = 0
+            cnt_ref[3] = carr[6]                  # hash collisions
 
+    extra_in = ([b2b] if has_b2 else []) + v2 + l2
     res = pl.pallas_call(
         kernel,
         out_shape=out_shapes,
         grid=(blocks,),
         in_specs=[pl.BlockSpec((BR, LANES), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM)] * (2 + L),
+                               memory_space=pltpu.VMEM)] * (2 + len(extra_in)),
         out_specs=([pl.BlockSpec(memory_space=pl.ANY)] * (nA + nB)
                    + [pl.BlockSpec(memory_space=pltpu.SMEM)]),
         scratch_shapes=scratch,
@@ -446,7 +502,7 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
         interpret=interpret,
     )
     with _x32_trace():
-        res = res(b2, t2, *l2)
+        res = res(b2, t2, *extra_in)
     return res[nA + nB], tuple(res[:nA]), tuple(res[nA:nA + nB])
 
 

@@ -1,0 +1,164 @@
+"""JoinAlgorithm.HASH — the hash-stream join (2x32-bit row hash sort +
+verify lanes + exact collision fallback) vs the XLA sort plan, on the
+public join API under the Pallas interpreter."""
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.ops import join as _join
+
+
+@pytest.fixture
+def ctx():
+    return ct.CylonContext.Init()
+
+
+def _rows(t: ct.Table):
+    d = t.to_pydict()
+    cols = list(d.values())
+    out = []
+    for i in range(len(cols[0]) if cols else 0):
+        row = []
+        for c in cols:
+            v = c[i]
+            if isinstance(v, (float, np.floating)) and np.isnan(v):
+                v = None
+            row.append(v)
+        out.append(tuple(row))
+    return Counter(out)
+
+
+def _join_both(left, right, jt, **kw):
+    old = _join.STREAM_PLAN
+    try:
+        _join.STREAM_PLAN = False
+        ref = left.join(right, jt, "sort", **kw)
+        _join.STREAM_PLAN = True
+        got = left.join(right, jt, "hash", **kw)
+    finally:
+        _join.STREAM_PLAN = old
+    return ref, got
+
+
+@pytest.mark.parametrize("jt", ["inner", "left", "right"])
+def test_hash_join_multikey(ctx, jt):
+    rng = np.random.default_rng(17)
+    n = 600
+    left = ct.Table.from_pydict(ctx, {
+        "a": rng.integers(0, 12, n).astype(np.int32),
+        "b": rng.integers(0, 12, n).astype(np.int32),
+        "v": rng.integers(0, 1000, n).astype(np.int32),
+    })
+    right = ct.Table.from_pydict(ctx, {
+        "a": rng.integers(0, 12, n).astype(np.int32),
+        "b": rng.integers(0, 12, n).astype(np.int32),
+        "w": rng.integers(0, 1000, n).astype(np.int32),
+    })
+    ref, got = _join_both(left, right, jt, on=["a", "b"])
+    assert _rows(got) == _rows(ref)
+
+
+def test_hash_join_single_key_and_floats(ctx):
+    rng = np.random.default_rng(3)
+    n = 400
+    left = ct.Table.from_pydict(ctx, {
+        "k": rng.normal(size=n).astype(np.float32),
+        "v": rng.integers(0, 100, n).astype(np.int32)})
+    # duplicate some float keys across sides
+    rk = np.concatenate([np.asarray(left.get_column(0).data)[:200],
+                         rng.normal(size=n - 200).astype(np.float32)])
+    right = ct.Table.from_pydict(ctx, {
+        "k": rk, "w": rng.integers(0, 100, n).astype(np.int32)})
+    ref, got = _join_both(left, right, "inner", on="k")
+    assert _rows(got) == _rows(ref)
+
+
+def test_hash_join_int64_keys(ctx):
+    # 8-byte keys (2 verify lanes per key) — outside the sort-stream
+    # path's reach, exactly what the hash path exists for
+    rng = np.random.default_rng(5)
+    n = 500
+    base = rng.integers(0, 50, n).astype(np.int64) + (1 << 40)
+    left = ct.Table.from_pydict(ctx, {
+        "k": base, "v": rng.integers(0, 9, n).astype(np.int32)})
+    right = ct.Table.from_pydict(ctx, {
+        "k": rng.permutation(base),
+        "w": rng.integers(0, 9, n).astype(np.int32)})
+    ref, got = _join_both(left, right, "inner", on="k")
+    assert _rows(got) == _rows(ref)
+
+
+def test_hash_join_nulls_and_strings(ctx):
+    import pandas as pd
+
+    rng = np.random.default_rng(7)
+    n = 300
+    k = rng.integers(0, 25, n).astype(np.float64)
+    k[rng.random(n) < 0.2] = np.nan
+    vocab = np.array([f"s{i}" for i in range(10)])
+    left = ct.Table.from_pandas(ctx, pd.DataFrame({
+        "k": k.astype(np.float32),
+        "s": vocab[rng.integers(0, 10, n)],
+        "v": np.arange(n, dtype=np.int32)}))
+    right = ct.Table.from_pandas(ctx, pd.DataFrame({
+        "k": rng.integers(0, 25, n).astype(np.float32),
+        "s": vocab[rng.integers(0, 10, n)],
+        "w": np.arange(n, dtype=np.int32)}))
+    for jt in ("inner", "left"):
+        ref, got = _join_both(left, right, jt, on=["k", "s"])
+        assert _rows(got) == _rows(ref)
+
+
+def test_hash_join_collision_falls_back(ctx, monkeypatch):
+    """Force every row to one hash bucket: the plan must detect the
+    within-run key mismatches and the join must still be exact via the
+    XLA fallback."""
+    from cylon_tpu.ops import hash as _hash
+
+    monkeypatch.setattr(_hash, "fmix32", lambda h: h * jnp_u32_zero())
+    monkeypatch.setattr(_hash, "fmix32b", lambda h: h * jnp_u32_zero())
+    rng = np.random.default_rng(11)
+    n = 200
+    left = ct.Table.from_pydict(ctx, {
+        "a": rng.integers(0, 8, n).astype(np.int32),
+        "b": rng.integers(0, 8, n).astype(np.int32),
+        "v": rng.integers(0, 99, n).astype(np.int32)})
+    right = ct.Table.from_pydict(ctx, {
+        "a": rng.integers(0, 8, n).astype(np.int32),
+        "b": rng.integers(0, 8, n).astype(np.int32),
+        "w": rng.integers(0, 99, n).astype(np.int32)})
+    old = _join.STREAM_PLAN
+    try:
+        _join.STREAM_PLAN = True
+        got = left.join(right, "inner", "hash", on=["a", "b"])
+        _join.STREAM_PLAN = False
+        ref = left.join(right, "inner", "sort", on=["a", "b"])
+    finally:
+        _join.STREAM_PLAN = old
+    assert _rows(got) == _rows(ref)
+
+
+def jnp_u32_zero():
+    import jax.numpy as jnp
+
+    return jnp.uint32(0)
+
+
+def test_hash_outer_falls_back(ctx):
+    # FULL_OUTER is outside the hash-stream path; must not crash
+    rng = np.random.default_rng(13)
+    t1 = ct.Table.from_pydict(ctx, {
+        "a": rng.integers(0, 6, 80).astype(np.int32),
+        "b": rng.integers(0, 6, 80).astype(np.int32)})
+    t2 = ct.Table.from_pydict(ctx, {
+        "a": rng.integers(0, 6, 80).astype(np.int32),
+        "b": rng.integers(0, 6, 80).astype(np.int32)})
+    old = _join.STREAM_PLAN
+    try:
+        _join.STREAM_PLAN = True
+        out = t1.join(t2, "outer", "hash", on=["a", "b"])
+    finally:
+        _join.STREAM_PLAN = old
+    assert out.row_count >= 80
