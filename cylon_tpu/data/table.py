@@ -734,12 +734,10 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     return Table(cols, left._ctx, emit)
 
 
-def set_op(left: Table, right: Table, op) -> Table:
-    """Local union/subtract/intersect (reference: table.cpp:729-942)."""
-    gl, gr = row_gids(left, right)
-    rows = _setops.setop_rows(gl, gr, left.emit_mask(), right.emit_mask(), op)
-    nl = left.capacity
-    out_cols = []
+def _aligned_setop_columns(left: Table, right: Table):
+    """Schema-aligned column pairs for set ops: dtypes promoted,
+    dictionaries unified."""
+    lcols, rcols = [], []
     for ci in range(left.column_count):
         a, b = left._columns[ci], right._columns[ci]
         if a.is_string:
@@ -748,6 +746,27 @@ def set_op(left: Table, right: Table, op) -> Table:
             common = jnp.promote_types(a.data.dtype, b.data.dtype)
             a = a.astype(dtypes.from_np_dtype(common))
             b = b.astype(dtypes.from_np_dtype(common))
+        lcols.append(a)
+        rcols.append(b)
+    return lcols, rcols
+
+
+def set_op(left: Table, right: Table, op) -> Table:
+    """Local union/subtract/intersect (reference: table.cpp:729-942).
+    The streaming full-row-hash path handles lane-packable schemas in
+    one sort + one Pallas pass; the dense-ranks path is the general
+    (and collision) fallback."""
+    if left.column_count != right.column_count:
+        raise CylonError(Code.Invalid, "set ops need equal schemas")
+    lcols, rcols = _aligned_setop_columns(left, right)
+    out = _setops.setop_stream_table(left, right, lcols, rcols, op)
+    if out is not None:
+        return out
+
+    gl, gr = row_gids(left, right)
+    rows = _setops.setop_rows(gl, gr, left.emit_mask(), right.emit_mask(), op)
+    out_cols = []
+    for a, b in zip(lcols, rcols):
         data = jnp.concatenate([a.data, b.data])
         validity = None
         if a.validity is not None or b.validity is not None:

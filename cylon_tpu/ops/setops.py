@@ -55,6 +55,8 @@ def setop_counts(gl, gr, lemit, remit):
 def _first_occurrence(g) -> jnp.ndarray:
     """True at the first row (in table order) holding each distinct id."""
     n = g.shape[0]
+    if n == 0:
+        return jnp.zeros(0, dtype=bool)
     iota = jnp.arange(n, dtype=jnp.int32)
     gs, idxs = jax.lax.sort((g, iota), num_keys=1)
     neq = jnp.zeros(n, dtype=bool).at[0].set(True)
@@ -114,3 +116,183 @@ def setop_rows(gl, gr, lemit, remit, op: SetOp) -> np.ndarray:
     cap = pow2(total)
     idx = setop_indices(gl, gr, lemit, remit, op, cap)
     return np.asarray(idx)[:total]
+
+
+# ---------------------------------------------------------------------------
+# Streaming set-op path: ONE fused sort on a 2x32-bit full-row hash + ONE
+# Pallas pass (tpu_kernels.setop_stream) replaces the ~8 sorts + scatters
+# above; the row payload rides the sort as u32 lanes, doubling as the
+# hash-verify lanes and as the compacted output. Exact: any within-run
+# lane mismatch (64-bit hash collision) makes the caller recompute via
+# the dense-ranks path.
+# ---------------------------------------------------------------------------
+
+# None = auto (TPU only); False disables; True forces (interpreter tests)
+STREAM_SETOP = None
+
+# sort operands = 3 (h1, h2, tag) + lane budget
+MAX_SETOP_LANES = 12
+
+
+def setop_lane_descs(lcols, rcols):
+    """Static lane plan over ALIGNED column pairs, or None when any
+    column can't ride u32 lanes within budget. Per column: (kind,
+    has_validity) with kind "d" (4-byte bit-exact), "n" (1/2-byte
+    widened), "b" (bool), "w" (8-byte split hi/lo)."""
+    descs = []
+    total = 0
+    for a, b in zip(lcols, rcols):
+        has_v = a.validity is not None or b.validity is not None
+        if a.is_string:
+            kind, slots = "d", 1
+        elif a.data.dtype == jnp.bool_:
+            kind, slots = "b", 1
+        elif a.data.ndim != 1:
+            return None
+        else:
+            w = np.dtype(a.data.dtype).itemsize
+            if w == 4:
+                kind, slots = "d", 1
+            elif w == 8:
+                kind, slots = "w", 2
+            elif w in (1, 2):
+                kind, slots = "n", 1
+            else:
+                return None
+        total += slots + (1 if has_v else 0)
+        if total > MAX_SETOP_LANES:
+            return None
+        descs.append((kind, has_v))
+    return tuple(descs)
+
+
+def setop_stream_applicable(n_total: int, descs) -> bool:
+    if STREAM_SETOP is False or descs is None:
+        return False
+    if n_total == 0 or n_total >= (1 << 29):
+        return False
+    if STREAM_SETOP:
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _col_lanes(col, other_has_v, kind):
+    """Canonical u32 lanes for one side's column: equal VALUES produce
+    equal lane bits (floats: -0.0 normalized; null cells: forced 0 with
+    the validity lane carrying the distinction)."""
+    x = col.data
+    if kind == "b":
+        bits = [x.astype(jnp.uint32)]
+    elif kind == "n":
+        bits = [x.astype(jnp.uint32)]
+    elif kind == "w":
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x = jnp.where(x == 0, jnp.zeros((), x.dtype), x)
+        u = x.view(jnp.uint64)
+        bits = [(u >> 32).astype(jnp.uint32), u.astype(jnp.uint32)]
+    else:
+        if x.dtype != jnp.bool_ and jnp.issubdtype(x.dtype, jnp.floating):
+            x = jnp.where(x == 0, jnp.zeros((), x.dtype), x)
+        bits = [x if x.dtype == jnp.uint32 else x.view(jnp.uint32)]
+    has_v = col.validity is not None or other_has_v
+    if has_v:
+        vm = col.valid_mask()
+        bits = [jnp.where(vm, b, jnp.uint32(0)) for b in bits]
+        bits.append(vm.astype(jnp.uint32))
+    return bits
+
+
+@partial(jax.jit, static_argnames=("descs", "op", "block_rows",
+                                   "interpret"))
+def _setop_stream_program(lane_l, lane_r, lemit, remit, descs, op: SetOp,
+                          block_rows: int, interpret: bool):
+    from .hash import fmix32, fmix32b
+    from . import tpu_kernels as tk
+
+    nl = lemit.shape[0]
+    nr = remit.shape[0]
+    n = nl + nr
+    lanes = [jnp.concatenate([a, b]) for a, b in zip(lane_l, lane_r)]
+    live = jnp.concatenate([lemit, remit])
+    iota = jnp.arange(n, dtype=jnp.uint32)
+    tag = (jnp.concatenate([jnp.full(nl, jnp.uint32(1 << 31)),
+                            jnp.zeros(nr, jnp.uint32)])
+           | (live.astype(jnp.uint32) << 29) | iota)
+    h1 = jnp.zeros(n, jnp.uint32)
+    h2 = jnp.full(n, jnp.uint32(0x9E3779B9))
+    for ln in lanes:
+        h1 = h1 * jnp.uint32(31) + fmix32(ln)
+        h2 = h2 * jnp.uint32(33) + fmix32b(ln)
+    allones = jnp.uint32(0xFFFFFFFF)
+    h1 = jnp.where(live, fmix32(h1), allones)
+    h2 = jnp.where(live, fmix32b(h2), allones)
+    res = jax.lax.sort((h1, h2, tag) + tuple(lanes), num_keys=3)
+    return tk.setop_stream(res[0], res[1], res[2], res[3:], int(op),
+                           block_rows=block_rows, interpret=interpret)
+
+
+def setop_stream_table(left, right, lcols, rcols, op: SetOp):
+    """Try the streaming set-op. Returns the result Table or None (not
+    applicable / hash collision — caller uses the dense-ranks path).
+    lcols/rcols: schema-ALIGNED columns (dtypes promoted, dictionaries
+    unified)."""
+    from ..data.column import Column
+    from ..data.table import Table
+    from ..util import capacity as _capacity
+    from .join import stream_block_rows
+
+    descs = setop_lane_descs(lcols, rcols)
+    nl, nr = left.capacity, right.capacity
+    if not setop_stream_applicable(nl + nr, descs):
+        return None
+    interpret = jax.default_backend() != "tpu"
+    br = stream_block_rows(nl, nr)
+
+    lane_l, lane_r = [], []
+    for (kind, _), a, b in zip(descs, lcols, rcols):
+        other_v_a = b.validity is not None
+        lane_l.extend(_col_lanes(a, other_v_a, kind))
+        lane_r.extend(_col_lanes(b, a.validity is not None, kind))
+    lemit = left.emit_mask()
+    remit = right.emit_mask()
+
+    if interpret:
+        counts, streams = _setop_stream_program.__wrapped__(
+            tuple(lane_l), tuple(lane_r), lemit, remit, descs, op,
+            br, True)
+    else:
+        counts, streams = _setop_stream_program(
+            tuple(lane_l), tuple(lane_r), lemit, remit, descs, op,
+            br, False)
+    host = jax.device_get(counts)
+    n_out, n_coll = int(host[0]), int(host[1])
+    if n_coll > 0:
+        return None
+    cap = _capacity(n_out)
+    flat = [s.reshape(-1)[:cap] for s in streams[1:]]  # drop idx stream
+
+    cols = []
+    k = 0
+    emit = jnp.arange(cap, dtype=jnp.int32) < n_out
+    for (kind, has_v), a in zip(descs, lcols):
+        if kind == "w":
+            hi, lo = flat[k], flat[k + 1]
+            u = (hi.astype(jnp.uint64) << 32) | lo.astype(jnp.uint64)
+            data = u.view(a.data.dtype)
+            k += 2
+        elif kind == "b":
+            data = flat[k] != 0
+            k += 1
+        elif kind == "n":
+            data = flat[k].astype(a.data.dtype)
+            k += 1
+        else:
+            data = flat[k] if a.data.dtype == jnp.uint32 \
+                else flat[k].view(a.data.dtype)
+            k += 1
+        validity = None
+        if has_v:
+            validity = (flat[k] != 0) & emit
+            k += 1
+        cols.append(Column(data, a.dtype, validity, a.dictionary, a.name))
+    return Table(cols, left._ctx, emit)

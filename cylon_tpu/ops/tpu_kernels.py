@@ -507,6 +507,168 @@ def join_plan_stream(bits_s: jnp.ndarray, tag_s: jnp.ndarray, na: int,
 
 
 # ---------------------------------------------------------------------------
+# setop_stream — streaming set operations (union/subtract/intersect)
+# ---------------------------------------------------------------------------
+
+
+def setop_stream(bits_s: jnp.ndarray, bits2_s: jnp.ndarray,
+                 tag_s: jnp.ndarray, lanes: Sequence[jnp.ndarray],
+                 op: int, block_rows: int = 64, interpret: bool = False):
+    """ONE sequential pass over the full-row-hash-sorted stream that
+    computes a distinct set operation and compacts its output rows —
+    replacing the XLA path's ~8 full sorts + scatters (dense ranks,
+    first-occurrence, membership, masked-indices; reference semantics:
+    table.cpp:729-942 hash-set union/subtract/intersect).
+
+    Inputs sorted together by (bits, bits2, tag): bits/bits2 = 2x32-bit
+    full-row hash (dead rows forced all-ones), tag = ``side<<31 |
+    live<<29 | iota`` with side=1 for the LEFT table — so within a run
+    all right rows precede all left rows, and at any left element the
+    inclusive right-prefix count IS the run's right total. lanes carry
+    the canonicalized row payload; they double as hash-verify lanes
+    (within-run mismatch => counts[1] collision, caller recomputes
+    exactly) and as the compacted output.
+
+    op: 0=UNION (first live element of each run, either side),
+    1=SUBTRACT (first live left of runs with no live right),
+    2=INTERSECT (first live left of runs with at least one live right).
+
+    Returns (counts i32[2] = [n_out, n_collisions], out_streams) with
+    out_streams = (idx, lane…) compacted at emitted rows; idx addresses
+    the concatenated [left; right] row space.
+    """
+    n = bits_s.shape[0]
+    BR = block_rows
+    L = len(lanes)
+    nO = 1 + L
+    assert BR % 8 == 0 and BR >= 8
+    assert n < (1 << 29)
+    blocks = max(-(-n // (BR * LANES)), 1)
+    rows = blocks * BR
+    allones = jnp.uint32(0xFFFFFFFF)
+    b1 = pad_rows(bits_s, rows, fill=allones)
+    b2 = pad_rows(bits2_s, rows, fill=allones)
+    t2 = pad_rows(tag_s, rows, fill=0)
+    l2 = [pad_rows(x, rows, fill=0) for x in lanes]
+
+    out_rows = rows_for(n) + BR + 8
+    out_shapes = ([jax.ShapeDtypeStruct((out_rows, LANES), jnp.uint32)] * nO
+                  + [jax.ShapeDtypeStruct((2,), jnp.int32)])
+
+    # tails: [0,nO) output-group partial rows, then prev carries:
+    # bits, bits2, tag, lanes…
+    t_prev = nO
+    n_tails = t_prev + 3 + L
+    scratch = ([pltpu.SMEM((8,), jnp.int32),
+                pltpu.VMEM((n_tails, LANES), jnp.uint32)]
+               + [pltpu.VMEM((BR + 8, LANES), jnp.uint32)
+                  for _ in range(nO)]
+               + [pltpu.SemaphoreType.DMA((nO,))])
+
+    def kernel(b1_ref, b2_ref, tag_ref, *rest):
+        lane_refs = rest[:L]
+        outs = rest[L:L + nO]
+        cnt_ref = rest[L + nO]
+        carr = rest[L + nO + 1]
+        tails = rest[L + nO + 2]
+        bufs = list(rest[L + nO + 3:L + nO + 3 + nO])
+        sems = rest[L + nO + 3 + nO]
+        i = pl.program_id(0)
+        bits = b1_ref[:]
+        bits2 = b2_ref[:]
+        tag = tag_ref[:]
+        lane_vals = [r[:] for r in lane_refs]
+
+        @pl.when(i == 0)
+        def _():
+            carr[0] = 0  # inclusive live-left count
+            carr[1] = 0  # inclusive live-right count
+            carr[2] = 0  # running max of head left-before
+            carr[3] = 0  # running max of head right-before
+            carr[4] = 0  # output write pointer
+            carr[6] = 0  # collision count
+            tails[:] = jnp.zeros((n_tails, LANES), jnp.uint32)
+
+        def prev_of(x, trow, fill0):
+            pf = jnp.where(i == 0, fill0, tails[trow, LANES - 1])
+            return flat_shift(x, jnp.int32(1), fill=pf,
+                              interpret=interpret)
+
+        neq = (bits != prev_of(bits, t_prev, bits[0, 0] + jnp.uint32(1))) \
+            | (bits2 != prev_of(bits2, t_prev + 1,
+                                bits2[0, 0] + jnp.uint32(1)))
+        side = (tag >> 31) == 1
+        live = ((tag >> 29) & 1) == 1
+        idx_u = tag & jnp.uint32((1 << 29) - 1)
+
+        ptag = prev_of(tag, t_prev + 2, jnp.uint32(0))
+        prev_live = ((ptag >> 29) & 1) == 1
+        coll = jnp.zeros(bits.shape, bool)
+        for vi in range(L):
+            coll = coll | (lane_vals[vi] != prev_of(
+                lane_vals[vi], t_prev + 3 + vi, jnp.uint32(0)))
+        coll = (coll | ~prev_live) & (~neq) & live
+        carr[6] = carr[6] + jnp.sum(coll.astype(jnp.int32))
+
+        ill = (side & live).astype(jnp.int32)
+        ibr = ((~side) & live).astype(jnp.int32)
+        cum_l = block_cumsum(ill, interpret) + carr[0]
+        cum_r = block_cumsum(ibr, interpret) + carr[1]
+        # run-head prefix broadcast via running max (heads non-decreasing)
+        l_before = jnp.maximum(
+            block_cummax(jnp.where(neq, cum_l - ill, 0), interpret),
+            carr[2])
+        r_before = jnp.maximum(
+            block_cummax(jnp.where(neq, cum_r - ibr, 0), interpret),
+            carr[3])
+        l_at = cum_l - l_before  # inclusive live-left count within run
+        r_at = cum_r - r_before  # inclusive live-right count within run
+
+        if op == 0:      # UNION: first live element of the run
+            emitm = live & ((l_at + r_at) == 1)
+        elif op == 1:    # SUBTRACT: first live left, no live right in run
+            emitm = (ill == 1) & (l_at == 1) & (r_at == 0)
+        else:            # INTERSECT: first live left, some live right
+            emitm = (ill == 1) & (l_at == 1) & (r_at > 0)
+
+        carr[0] = cum_l[BR - 1, LANES - 1]
+        carr[1] = cum_r[BR - 1, LANES - 1]
+        carr[2] = l_before[BR - 1, LANES - 1]
+        carr[3] = r_before[BR - 1, LANES - 1]
+        tails[t_prev:t_prev + 1, :] = bits[BR - 1:BR, :]
+        tails[t_prev + 1:t_prev + 2, :] = bits2[BR - 1:BR, :]
+        tails[t_prev + 2:t_prev + 3, :] = tag[BR - 1:BR, :]
+        for vi in range(L):
+            tails[t_prev + 3 + vi:t_prev + 4 + vi, :] = \
+                lane_vals[vi][BR - 1:BR, :]
+
+        _compact_write(BR, emitm.astype(jnp.int32), [idx_u] + lane_vals,
+                       list(outs), carr, 4, tails, 0, bufs, sems, 0,
+                       interpret)
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _():
+            cnt_ref[0] = carr[4]
+            cnt_ref[1] = carr[6]
+
+    res = pl.pallas_call(
+        kernel,
+        out_shape=out_shapes,
+        grid=(blocks,),
+        in_specs=[pl.BlockSpec((BR, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)] * (3 + L),
+        out_specs=([pl.BlockSpec(memory_space=pl.ANY)] * nO
+                   + [pl.BlockSpec(memory_space=pltpu.SMEM)]),
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )
+    with _x32_trace():
+        res = res(b1, b2, t2, *l2)
+    return res[nO], tuple(res[:nO])
+
+
+# ---------------------------------------------------------------------------
 # join_expand_stream — the streaming join materializer
 # ---------------------------------------------------------------------------
 
