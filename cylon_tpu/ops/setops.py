@@ -184,7 +184,13 @@ def _col_lanes(col, other_has_v, kind):
     if kind == "b":
         bits = [x.astype(jnp.uint32)]
     elif kind == "n":
-        bits = [x.astype(jnp.uint32)]
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            # float16: bitcast, not value-cast — a value cast truncates
+            # distinct halves (1.25 vs 1.5) to the same integer.
+            x = jnp.where(x == 0, jnp.zeros((), x.dtype), x)
+            bits = [x.view(jnp.uint16).astype(jnp.uint32)]
+        else:
+            bits = [x.astype(jnp.uint32)]
     elif kind == "w":
         if jnp.issubdtype(x.dtype, jnp.floating):
             x = jnp.where(x == 0, jnp.zeros((), x.dtype), x)
@@ -268,7 +274,11 @@ def setop_stream_table(left, right, lcols, rcols, op: SetOp):
     n_out, n_coll = int(host[0]), int(host[1])
     if n_coll > 0:
         return None
-    cap = _capacity(n_out)
+    # cap may overshoot the padded stream length when n_out is close to
+    # n (capacity() rounds up ~6%); jnp slicing clamps silently, which
+    # would leave columns shorter than the emit mask. Clamp to the
+    # stream element count — it is always >= n_out.
+    cap = min(_capacity(n_out), streams[1].size)
     flat = [s.reshape(-1)[:cap] for s in streams[1:]]  # drop idx stream
 
     cols = []
@@ -284,7 +294,10 @@ def setop_stream_table(left, right, lcols, rcols, op: SetOp):
             data = flat[k] != 0
             k += 1
         elif kind == "n":
-            data = flat[k].astype(a.data.dtype)
+            if jnp.issubdtype(jnp.dtype(a.data.dtype), jnp.floating):
+                data = flat[k].astype(jnp.uint16).view(a.data.dtype)
+            else:
+                data = flat[k].astype(a.data.dtype)
             k += 1
         else:
             data = flat[k] if a.data.dtype == jnp.uint32 \

@@ -17,6 +17,7 @@
 // Build: scripts/build_native.sh (g++ -O3 -shared -fPIC -pthread).
 
 #include <atomic>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -202,16 +203,21 @@ int64_t ct_write_csv(const void** cols, const int32_t* dtypes,
                   static_cast<long long>(
                       reinterpret_cast<const int64_t*>(cols[c])[i]));
               break;
-            case 2:
-              len = std::snprintf(
-                  buf, sizeof buf, "%.9g",
-                  static_cast<double>(
-                      reinterpret_cast<const float*>(cols[c])[i]));
+            case 2: {
+              // NaN serializes as an empty field, matching the pandas
+              // fallback path so output is writer-independent.
+              float v = reinterpret_cast<const float*>(cols[c])[i];
+              if (std::isnan(v)) break;
+              len = std::snprintf(buf, sizeof buf, "%.9g",
+                                  static_cast<double>(v));
               break;
-            case 3:
-              len = std::snprintf(buf, sizeof buf, "%.17g",
-                                  reinterpret_cast<const double*>(cols[c])[i]);
+            }
+            case 3: {
+              double v = reinterpret_cast<const double*>(cols[c])[i];
+              if (std::isnan(v)) break;
+              len = std::snprintf(buf, sizeof buf, "%.17g", v);
               break;
+            }
             case 4:
               len = std::snprintf(buf, sizeof buf, "%u",
                                   reinterpret_cast<const uint32_t*>(cols[c])[i]);
