@@ -30,11 +30,13 @@ from ..status import Code, CylonError
 
 class Column:
     def __init__(self, data, dtype: DataType, validity=None, dictionary=None,
-                 name: str = ""):
-        self.data = data              # jnp array [n] (codes for STRING)
+                 name: str = "", varbytes=None):
+        self.data = data              # jnp array [n] (codes for dict STRING,
+        #                               byte lengths for varbytes STRING)
         self.dtype = dtype
         self.validity = validity      # jnp bool [n] (True=valid) or None
-        self.dictionary = dictionary  # np.ndarray (sorted) for STRING/BINARY
+        self.dictionary = dictionary  # np.ndarray (sorted) for dict STRING
+        self.varbytes = varbytes      # strings.VarBytes for varlen STRING
         self.name = name
 
     # -- construction --
@@ -64,17 +66,41 @@ class Column:
     @staticmethod
     def _encode_strings(arr: np.ndarray, name: str,
                         validity: Optional[np.ndarray]) -> "Column":
+        from .strings import DICT_MAX_RATIO, DICT_MAX_VOCAB, VarBytes
+
         obj = arr.astype(object)
         if validity is None:
             validity = np.array([v is not None and v == v for v in obj], dtype=bool)
         filler = ""
         safe = np.array([v if ok else filler for v, ok in zip(obj, validity)],
                         dtype=object)
+        n = len(obj)
+        thresh = min(DICT_MAX_VOCAB, max(16, int(n * DICT_MAX_RATIO)))
+        # chunked distinct probe with early bail: the varbytes branch
+        # (exactly the high-cardinality case) must not pay np.unique's
+        # O(n log n) host string sort just to discard it
+        seen: set = set()
+        for lo in range(0, n, 1 << 16):
+            seen.update(safe[lo: lo + (1 << 16)])
+            if len(seen) > thresh:
+                vb = VarBytes.from_host(safe)
+                return Column.from_varbytes(
+                    vb, _dev_mask(validity if not validity.all() else None),
+                    name)
         vocab, codes = np.unique(safe.astype(str), return_inverse=True)
         col = Column(jnp.asarray(codes.astype(np.int32)), dtypes.String(),
                      _dev_mask(validity if not validity.all() else None),
                      vocab, name)
         return col
+
+    @staticmethod
+    def from_varbytes(vb, validity=None, name: str = "",
+                      dtype: Optional[DataType] = None) -> "Column":
+        """Wrap device-native varlen storage (data/strings.py). The
+        Column's ``data`` array carries the byte lengths so generic
+        shape/row plumbing works; content lives in ``varbytes``."""
+        return Column(vb.lengths, dtype or dtypes.String(), validity,
+                      None, name, varbytes=vb)
 
     @staticmethod
     def from_pyarrow(pa_arr, name: str = "") -> "Column":
@@ -90,6 +116,33 @@ class Column:
         nulls = pa_arr.null_count > 0
         if pa.types.is_string(t) or pa.types.is_large_string(t) or \
                 pa.types.is_binary(t) or pa.types.is_large_binary(t):
+            import pyarrow.compute as pac
+
+            from .strings import DICT_MAX_RATIO, DICT_MAX_VOCAB, VarBytes
+
+            n = len(pa_arr)
+            is_bin = pa.types.is_binary(t) or pa.types.is_large_binary(t)
+            nuniq = pac.count_distinct(pa_arr).as_py() if n else 0
+            if is_bin or \
+                    nuniq > min(DICT_MAX_VOCAB, max(16, int(n * DICT_MAX_RATIO))):
+                # high cardinality (or non-UTF8 binary, which the sorted-
+                # str vocab can't represent) → varbytes straight from
+                # Arrow buffers; nulls become empty rows under validity
+                if nulls:
+                    validity = np.asarray(pa_arr.is_valid())
+                    pa_arr = pac.fill_null(pa_arr, b"" if is_bin else "")
+                else:
+                    validity = None
+                bufs = pa_arr.buffers()
+                odt = np.int64 if pa.types.is_large_string(t) or \
+                    pa.types.is_large_binary(t) else np.int32
+                offsets = np.frombuffer(bufs[1], odt)[
+                    pa_arr.offset: pa_arr.offset + n + 1]
+                data = bufs[2].to_pybytes() if bufs[2] is not None else b""
+                vb = VarBytes.from_arrow_buffers(offsets, data)
+                return Column.from_varbytes(
+                    vb, _dev_mask(validity), name,
+                    dtype=dtypes.Binary() if is_bin else None)
             np_obj = pa_arr.to_numpy(zero_copy_only=False)
             validity = np.array([v is not None for v in np_obj]) if nulls else None
             return Column._encode_strings(np.asarray(np_obj, dtype=object), name, validity)
@@ -123,7 +176,11 @@ class Column:
 
     @property
     def is_string(self) -> bool:
-        return self.dictionary is not None
+        return self.dictionary is not None or self.varbytes is not None
+
+    @property
+    def is_varbytes(self) -> bool:
+        return self.varbytes is not None
 
     def null_count(self) -> int:
         if self.validity is None:
@@ -147,28 +204,40 @@ class Column:
         """Gather rows; negative indices produce NULL rows (the reference's
         −1→null gather, util/copy_arrray.cpp:16-287)."""
         idx = jnp.asarray(indices)
-        if self.data.shape[0] == 0:
+        if self.data.shape[0] == 0 and not self.is_varbytes:
             data = jnp.zeros(idx.shape, self.data.dtype)
             return Column(data, self.dtype, jnp.zeros(idx.shape, bool),
                           self.dictionary, self.name)
         neg = idx < 0
         safe = jnp.where(neg, 0, idx)
-        data = jnp.take(self.data, safe, axis=0)
         validity = None
         if fill_invalid or self.validity is not None:
             # NOTE: an all-True mask is NOT collapsed to None here — that
             # would force a device→host sync on every gather (deadly over a
             # tunneled TPU). Export paths collapse it instead.
-            validity = jnp.take(self.valid_mask(), safe, axis=0) & ~neg
+            if self.data.shape[0] == 0:
+                validity = jnp.zeros(idx.shape, bool)
+            else:
+                validity = jnp.take(self.valid_mask(), safe, axis=0) & ~neg
+        if self.is_varbytes:
+            vb = self.varbytes.take(idx)  # negatives → empty rows
+            return Column(vb.lengths, self.dtype, validity, None, self.name,
+                          varbytes=vb)
+        data = jnp.take(self.data, safe, axis=0)
         return Column(data, self.dtype, validity, self.dictionary, self.name)
 
     def slice(self, start: int, stop: int) -> "Column":
         v = None if self.validity is None else self.validity[start:stop]
+        if self.is_varbytes:
+            vb = self.varbytes.slice(start, stop)
+            return Column(vb.lengths, self.dtype, v, None, self.name,
+                          varbytes=vb)
         return Column(self.data[start:stop], self.dtype, v, self.dictionary,
                       self.name)
 
     def rename(self, name: str) -> "Column":
-        return Column(self.data, self.dtype, self.validity, self.dictionary, name)
+        return Column(self.data, self.dtype, self.validity, self.dictionary,
+                      name, varbytes=self.varbytes)
 
     # -- export --
 
@@ -180,6 +249,13 @@ class Column:
         return None if mask.all() else mask
 
     def to_numpy(self) -> np.ndarray:
+        if self.is_varbytes:
+            out = self.varbytes.to_host(
+                as_str=self.dtype.type != Type.BINARY)
+            mask = self._host_mask()
+            if mask is not None:
+                out[~mask] = None
+            return out
         data = np.asarray(jax.device_get(self.data))
         mask = self._host_mask()
         if self.is_string:
@@ -207,14 +283,58 @@ class Column:
     def to_pyarrow(self):
         import pyarrow as pa
 
-        data = np.asarray(jax.device_get(self.data))
         valid = self._host_mask()
         mask = None if valid is None else ~valid
+        if self.is_varbytes:
+            if self.dtype.type == Type.BINARY:
+                return pa.array(self.varbytes.to_host(as_str=False),
+                                type=pa.binary(), mask=mask)
+            return pa.array(self.varbytes.to_host(), type=pa.string(),
+                            mask=mask)
+        data = np.asarray(jax.device_get(self.data))
         if self.is_string:
             vals = self.dictionary[data]
             return pa.array(vals, type=pa.string(),
                             mask=mask if mask is not None else None)
         return pa.array(data, mask=mask)
+
+
+def as_varbytes(col: Column) -> Column:
+    """Lift a string column to device-native varbytes storage. Dictionary
+    columns build the (small, host-resident by definition) vocab's
+    VarBytes once, then ONE device varlen gather re-materializes rows —
+    no per-row host work."""
+    from .strings import VarBytes
+
+    if col.is_varbytes:
+        return col
+    if not col.is_string:
+        raise CylonError(Code.TypeError, "as_varbytes needs a string column")
+    vocab_vb = VarBytes.from_host(col.dictionary)
+    vb = vocab_vb.take(col.data)
+    return Column(vb.lengths, col.dtype, col.validity, None, col.name,
+                  varbytes=vb)
+
+
+def align_string_columns(a: Column, b: Column) -> Tuple[Column, Column]:
+    """Make two string columns directly comparable on device: if either
+    side is varbytes, lift both (content hashes compare with no shared
+    vocabulary); two dictionary columns unify vocabularies instead."""
+    if a.is_varbytes or b.is_varbytes:
+        return as_varbytes(a), as_varbytes(b)
+    return unify_dictionaries(a, b)
+
+
+def string_key_arrays(col: Column):
+    """Device key arrays standing in for one string key column: varbytes
+    → (h1, h2, h3, len) content-hash identity; dictionary → the (already
+    rank-preserving) codes. Returns (keys, valids, flags) triples ready
+    to extend a join/groupby key list."""
+    if col.is_varbytes:
+        ks = col.varbytes.hash_keys()
+        return (list(ks), [col.validity] + [None] * (len(ks) - 1),
+                [False] * len(ks))
+    return [col.data], [col.validity], [True]
 
 
 def unify_dictionaries(a: Column, b: Column) -> Tuple[Column, Column]:

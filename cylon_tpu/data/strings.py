@@ -1,0 +1,387 @@
+"""Device-native variable-length strings: (starts, lengths, words).
+
+The reference handles arbitrary varlen binary through its whole stack
+(reference: cpp/src/cylon/arrow/arrow_partition_kernels.hpp:94
+`BinaryHashPartitionKernel`, arrow_kernels.hpp:101
+`BinaryArraySplitKernel`, join/join.cpp:648-799 string/binary dispatch)
+by pointer-walking Arrow (offsets, bytes) buffers per row. XLA has no
+ragged type and per-row pointer walks are scalar-unit poison on TPU, so
+the TPU-native design keeps the Arrow-style representation but makes
+every operation a fixed set of whole-array passes:
+
+* storage is WORD-ALIGNED: every row's bytes start at a 4-byte boundary
+  of one dense ``uint32`` word buffer (tail-padded with zero bytes), so
+  all content math runs on u32 vectors — no byte gathers;
+* rows are TIGHTLY PACKED: ``starts == exclusive_cumsum(ceil(len/4))``.
+  This invariant is what lets one unique-index scatter + cumsum recover
+  the word→row map with no searchsorted / segment_sum / cummax (all
+  measured TPU pathologies, see ops/join.py);
+* per-row content identity is a family of independent 32-bit polynomial
+  hashes computed with the prefix-sum range trick: contribution of word
+  j is ``g^p * mix(w_j)`` with p = j − row_start, so a row's hash is a
+  difference of two prefix sums — ONE cumsum per hash, zero per-row
+  loops. Join/groupby/set-op equality on device is (h1, h2, h3, byte
+  length): a false equality needs a 96-bit triple collision between
+  same-length rows (< 2^-70 odds for a billion distinct keys). The
+  reference compares bytes exactly; this is the deliberate TPU trade —
+  documented, and the dictionary path remains available when exactness
+  is demanded;
+* varlen gather (``take``) builds the output layout from the gathered
+  word counts and copies words through the same word→row map — two
+  scatters, two cumsums, three gathers, independent of row lengths.
+
+Dictionary encoding (data/column.py) remains the *optimization* for
+low-cardinality columns; this module is the general path whose
+vocabulary never materializes on host.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..util import capacity as _capacity
+
+# ingest policy: dictionary-encode when the vocabulary is small (device
+# codes sort faster and stay exact); otherwise varbytes
+DICT_MAX_VOCAB = 1 << 14
+DICT_MAX_RATIO = 0.5
+
+# Table.sort prefix depth: varbytes sorts are exact up to this many words
+# (4 bytes each); longer rows fall back to a host sort
+SORT_PREFIX_WORDS = 16
+
+# hash schemes: (g multiplier, seed, post-mix selector). g odd so g^p
+# never collapses mod 2^32; three independent schemes give 96 id bits.
+_G1, _G2, _G3 = np.uint32(31), np.uint32(0x01000193), np.uint32(0x9E3779B1)
+_S1, _S2, _S3 = np.uint32(0x2545F491), np.uint32(0x85EBCA6B), np.uint32(0xC2B2AE35)
+
+
+def _nwords(lengths: jnp.ndarray) -> jnp.ndarray:
+    return (lengths + 3) >> 2
+
+
+class VarBytes:
+    """Word-aligned varlen byte storage (see module docstring).
+
+    words:   jnp.uint32 [word_capacity], tightly packed rows then zeros
+    starts:  jnp.int32 [n] — word index of each row's first word
+    lengths: jnp.int32 [n] — byte length of each row
+    max_words: static int ≥ 1 — max ceil(len/4) over rows (sort prefix
+               bound; preserved through take/concat)
+    total_words: static int — words actually occupied (packed prefix)
+    shard_geom: None, or (rows_per_shard, words_per_shard) for a
+               row-SHARDED column: each shard's starts are shard-relative
+               so per-shard kernels stay self-contained; eager whole-
+               array ops globalize via ``eff_starts`` (correct despite
+               the inter-shard padding gaps — the hash/take range sums
+               are gap-immune).
+    """
+
+    def __init__(self, words, starts, lengths, max_words: int,
+                 total_words: int, shard_geom=None):
+        self.words = words
+        self.starts = starts
+        self.lengths = lengths
+        self.max_words = max(int(max_words), 1)
+        self.total_words = int(total_words)
+        self.shard_geom = shard_geom
+
+    def __len__(self) -> int:
+        return int(self.lengths.shape[0])
+
+    @property
+    def nrows(self) -> int:
+        return int(self.lengths.shape[0])
+
+    def eff_starts(self) -> jnp.ndarray:
+        """Starts as GLOBAL word indices (identity when unsharded)."""
+        if self.shard_geom is None:
+            return self.starts
+        rows, wstride = self.shard_geom
+        sid = jnp.arange(self.starts.shape[0], dtype=jnp.int32) \
+            // jnp.int32(rows)
+        return self.starts + sid * jnp.int32(wstride)
+
+    # ------------------------------------------------------------------
+    # host <-> device
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def from_host(values: Sequence, fill: bytes = b"") -> "VarBytes":
+        """Build from a sequence of str/bytes (None/NaN rows become
+        ``fill`` — validity is tracked by the owning Column)."""
+        enc = []
+        for v in values:
+            if v is None or (isinstance(v, float) and v != v):
+                enc.append(fill)
+            elif isinstance(v, bytes):
+                enc.append(v)
+            else:
+                enc.append(str(v).encode("utf-8"))
+        n = len(enc)
+        lengths = np.fromiter((len(b) for b in enc), np.int32, n) \
+            if n else np.zeros(0, np.int32)
+        src = b"".join(enc)
+        return VarBytes._from_packed(src, lengths)
+
+    @staticmethod
+    def from_arrow_buffers(offsets: np.ndarray, data: bytes) -> "VarBytes":
+        """Build from Arrow-style (offsets[n+1], bytes) — the zero-copy-
+        adjacent ingest path (reference: Arrow binary array layout)."""
+        offsets = np.asarray(offsets)
+        lengths = np.diff(offsets).astype(np.int32)
+        lo = int(offsets[0]) if offsets.size else 0
+        hi = int(offsets[-1]) if offsets.size else 0
+        return VarBytes._from_packed(bytes(data[lo:hi]),
+                                     lengths, src_offsets=offsets - lo)
+
+    @staticmethod
+    def _from_packed(src: bytes, lengths: np.ndarray,
+                     src_offsets: Optional[np.ndarray] = None) -> "VarBytes":
+        """Vectorized host realignment: contiguous source bytes →
+        word-aligned layout. All numpy, no per-row Python."""
+        n = lengths.shape[0]
+        nw = (lengths.astype(np.int64) + 3) // 4
+        starts = np.concatenate([[0], np.cumsum(nw)])
+        total_words = int(starts[-1])
+        cap = _capacity(max(total_words, 1))
+        out = np.zeros(cap * 4, np.uint8)
+        if len(src):
+            sbuf = np.frombuffer(src, np.uint8)
+            if src_offsets is None:
+                src_starts = np.concatenate(
+                    [[0], np.cumsum(lengths.astype(np.int64))])[:-1]
+            else:
+                src_starts = np.asarray(src_offsets[:-1], np.int64)
+            # dst position of source byte k (row r, in-row offset p):
+            # starts[r]*4 + p
+            rows_rep = np.repeat(np.arange(n), lengths)
+            p = np.arange(len(rows_rep)) - np.repeat(
+                np.cumsum(np.concatenate([[0], lengths.astype(np.int64)]))[:-1],
+                lengths)
+            dst = np.repeat(starts[:-1] * 4, lengths) + p
+            out[dst] = sbuf[np.repeat(src_starts, lengths) + p]
+        words = jnp.asarray(out.view("<u4"))
+        return VarBytes(words, jnp.asarray(starts[:-1].astype(np.int32)),
+                        jnp.asarray(lengths.astype(np.int32)),
+                        int(nw.max()) if n else 1, total_words)
+
+    def to_host(self, as_str: bool = True) -> np.ndarray:
+        """Decode to a host object array of str (or bytes)."""
+        words = np.asarray(jax.device_get(self.words))
+        starts = np.asarray(jax.device_get(self.eff_starts()))
+        lengths = np.asarray(jax.device_get(self.lengths))
+        raw = words.view(np.uint8).tobytes()
+        out = np.empty(len(starts), object)
+        for i in range(len(starts)):
+            b = raw[starts[i] * 4: starts[i] * 4 + lengths[i]]
+            out[i] = b.decode("utf-8", errors="replace") if as_str else b
+        return out
+
+    # ------------------------------------------------------------------
+    # device kernels
+    # ------------------------------------------------------------------
+
+    def hash_keys(self, validity=None) -> Tuple[jnp.ndarray, ...]:
+        """(h1, h2, h3, len) uint32 arrays — the device identity of each
+        row. Equal bytes ⇒ equal keys; unequal bytes collide only on a
+        96-bit triple collision at equal length. ``validity`` (bool [n]
+        or None) forces null rows to a shared tag so nulls group
+        together (callers usually ALSO carry validity as its own key)."""
+        h1, h2, h3 = _hash_rows(self.words, self.eff_starts(), self.lengths,
+                                self.max_words)
+        ln = self.lengths.astype(jnp.uint32)
+        if validity is not None:
+            tag = jnp.uint32(0x9E3779B9)
+            h1 = jnp.where(validity, h1, tag)
+            h2 = jnp.where(validity, h2, tag)
+            h3 = jnp.where(validity, h3, tag)
+            ln = jnp.where(validity, ln, jnp.uint32(0))
+        return h1, h2, h3, ln
+
+    def take(self, indices) -> "VarBytes":
+        """Varlen row gather; negative indices produce empty rows (the
+        −1→null discipline — validity is the owning Column's job).
+        Eager: one scalar host sync picks the output word capacity."""
+        idx = jnp.asarray(indices)
+        if self.nrows == 0 or idx.shape[0] == 0:
+            z = jnp.zeros(idx.shape[0], jnp.int32)
+            return VarBytes(jnp.zeros(1, jnp.uint32), z, z, 1, 0)
+        safe = jnp.maximum(idx, 0)
+        nw_src = _nwords(self.lengths)
+        nw = jnp.where(idx >= 0, jnp.take(nw_src, safe), 0)
+        total = int(nw.sum())  # the capacity decision (one scalar sync)
+        cap_w = _capacity(max(total, 1))
+        words, starts, lens = _take_program(
+            self.words, self.eff_starts(), self.lengths, idx, cap_w)
+        return VarBytes(words, starts, lens, self.max_words, total)
+
+    def sort_prefix_keys(self) -> list:
+        """Lexicographic sort keys: big-endian prefix words then byte
+        length. EXACT when max_words ≤ SORT_PREFIX_WORDS (zero-padding +
+        the length key order a true prefix first, which IS lexicographic
+        order); longer rows need the host fallback — callers check
+        ``sortable_on_device``."""
+        nw = _nwords(self.lengths)
+        keys = []
+        k_lim = min(self.max_words, SORT_PREFIX_WORDS)
+        wcap = self.words.shape[0]
+        estarts = self.eff_starts()
+        for k in range(k_lim):
+            pos = jnp.clip(estarts + k, 0, wcap - 1)
+            w = jnp.where(k < nw, jnp.take(self.words, pos), jnp.uint32(0))
+            keys.append(_bswap32(w))
+        keys.append(self.lengths.astype(jnp.uint32))
+        return keys
+
+    @property
+    def sortable_on_device(self) -> bool:
+        return self.max_words <= SORT_PREFIX_WORDS
+
+    def equals_literal(self, value) -> jnp.ndarray:
+        """Exact per-row equality against one host literal (bounded loop
+        over the literal's words)."""
+        b = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        pad = (-len(b)) % 4
+        lw = np.frombuffer(b + b"\0" * pad, "<u4")
+        eq = self.lengths == np.int32(len(b))
+        wcap = self.words.shape[0]
+        estarts = self.eff_starts()
+        for k, w in enumerate(lw):
+            pos = jnp.clip(estarts + k, 0, wcap - 1)
+            eq = eq & (jnp.take(self.words, pos) == jnp.uint32(w))
+        return eq
+
+    def slice(self, start: int, stop: int) -> "VarBytes":
+        # python-slice clamping semantics (match fixed-width columns)
+        n = self.nrows
+        start = max(0, min(int(start), n))
+        stop = max(start, min(int(stop), n))
+        return self.take(jnp.arange(start, stop, dtype=jnp.int32))
+
+
+def concat_varbytes(parts: Sequence[VarBytes]) -> VarBytes:
+    """Concatenate preserving the packed invariant: strip each part to
+    its occupied prefix, shift starts, repad to capacity."""
+    total = sum(p.total_words for p in parts)
+    cap = _capacity(max(total, 1))
+    bufs, starts, lens = [], [], []
+    off = 0
+    for p in parts:
+        bufs.append(p.words[:p.total_words])
+        starts.append(p.eff_starts() + jnp.int32(off))
+        lens.append(p.lengths)
+        off += p.total_words
+    pad = cap - total
+    if pad:
+        bufs.append(jnp.zeros(pad, jnp.uint32))
+    return VarBytes(jnp.concatenate(bufs), jnp.concatenate(starts),
+                    jnp.concatenate(lens),
+                    max(p.max_words for p in parts), total)
+
+
+# ---------------------------------------------------------------------------
+# traceable internals
+# ---------------------------------------------------------------------------
+
+
+def _bswap32(w: jnp.ndarray) -> jnp.ndarray:
+    return ((w & 0xFF) << 24) | ((w & 0xFF00) << 8) \
+        | ((w >> 8) & 0xFF00) | (w >> 24)
+
+
+def _mix(w: jnp.ndarray, seed) -> jnp.ndarray:
+    h = w ^ seed
+    h = h ^ (h >> 16)
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    return h
+
+
+def _pow_vec(g: np.uint32, e: jnp.ndarray, max_e: int) -> jnp.ndarray:
+    """g^e (mod 2^32) elementwise via bit decomposition — ceil(log2)
+    vector multiplies, no per-row loops."""
+    steps = max(int(max_e).bit_length(), 1)
+    e = jnp.clip(e, 0, (1 << steps) - 1).astype(jnp.uint32)
+    out = jnp.ones_like(e)
+    acc = jnp.uint32(g)
+    for b in range(steps):
+        out = jnp.where((e >> b) & 1 == 1, out * acc, out)
+        acc = acc * acc
+    return out
+
+
+def _word_row_map(starts, nw, W: int):
+    """(row, p) for every word slot: the covering row and the slot's
+    word offset within it. Requires tightly packed rows. Slots past the
+    packed prefix return clamped garbage — callers mask or never read
+    ranges that reach them."""
+    n = starts.shape[0]
+    iota_n = jnp.arange(n, dtype=jnp.int32)
+    nz = nw > 0
+    erank = jnp.cumsum(nz.astype(jnp.int32))
+    slot = jnp.where(nz, erank - 1, n)
+    nzrows = jnp.zeros(n, jnp.int32).at[slot].set(iota_n, mode="drop")
+    # starts of nonzero-length rows are strictly increasing → unique slots
+    mark = jnp.zeros(W, jnp.int32).at[
+        jnp.where(nz, starts, W)].set(1, mode="drop")
+    ridx = jnp.cumsum(mark) - 1
+    row = jnp.take(nzrows, jnp.clip(ridx, 0, max(n - 1, 0)))
+    p = jnp.arange(W, dtype=jnp.int32) - jnp.take(starts, row)
+    return row, p
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("max_words",))
+def _hash_rows(words, starts, lengths, max_words: int):
+    """Three independent per-row 32-bit content hashes via the
+    prefix-sum range trick (module docstring)."""
+    W = words.shape[0]
+    n = starts.shape[0]
+    if n == 0:
+        z = jnp.zeros(0, jnp.uint32)
+        return z, z, z
+    nw = _nwords(lengths)
+    _, p = _word_row_map(starts, nw, W)
+    end = jnp.clip(starts + nw - 1, 0, W - 1)
+    prev = jnp.clip(starts - 1, 0, W - 1)
+    has = nw > 0
+    out = []
+    for g, seed in ((_G1, _S1), (_G2, _S2), (_G3, _S3)):
+        c = _mix(words, seed) * _pow_vec(g, p, max_words)
+        P = jnp.cumsum(c)
+        hi = jnp.take(P, end)
+        lo = jnp.where(starts > 0, jnp.take(P, prev), jnp.uint32(0))
+        h = jnp.where(has, hi - lo, jnp.uint32(0))
+        h = h ^ (lengths.astype(jnp.uint32) * np.uint32(0x9E3779B1)) ^ seed
+        h = h ^ (h >> 16)
+        h = h * np.uint32(0x7FEB352D)
+        h = h ^ (h >> 15)
+        h = h * np.uint32(0x846CA68B)
+        h = h ^ (h >> 16)
+        out.append(h)
+    return tuple(out)
+
+
+@partial(jax.jit, static_argnames=("cap_w",))
+def _take_program(words, starts, lengths, idx, cap_w: int):
+    """Traceable varlen gather at static word capacity."""
+    W_src = words.shape[0]
+    safe = jnp.maximum(idx, 0)
+    hit = idx >= 0
+    nw_src = _nwords(lengths)
+    nw = jnp.where(hit, jnp.take(nw_src, safe), 0)
+    lens = jnp.where(hit, jnp.take(lengths, safe), 0)
+    starts_out = jnp.cumsum(nw) - nw
+    row, p = _word_row_map(starts_out, nw, cap_w)
+    src_start = jnp.take(jnp.take(starts, safe), row)
+    w = jnp.take(words, jnp.clip(src_start + p, 0, W_src - 1))
+    total = starts_out[-1] + nw[-1] if nw.shape[0] else jnp.int32(0)
+    valid = (jnp.arange(cap_w, dtype=jnp.int32) < total) \
+        & (p < jnp.take(nw, row))
+    return jnp.where(valid, w, jnp.uint32(0)), starts_out, lens

@@ -32,7 +32,9 @@ from .. import dtypes
 from ..config import CSVWriteOptions
 from ..context import CylonContext
 from ..status import Code, CylonError
-from .column import Column, unify_dictionaries
+from .column import (Column, align_string_columns, as_varbytes,
+                     string_key_arrays, unify_dictionaries)
+from .strings import concat_varbytes
 from .. import telemetry as _telemetry
 from ..ops import aggregates as _aggregates
 from ..ops import groupby as _groupby
@@ -311,7 +313,10 @@ class Table:
                     (order_by if isinstance(order_by, (list, tuple)) else [order_by])]
         asc = ascending if isinstance(ascending, (list, tuple)) \
             else [ascending] * len(cols_idx)
-        keys = _order.sort_keys([t._columns[i] for i in cols_idx], asc)
+        keys = _sort_keys_mixed([t._columns[i] for i in cols_idx], asc)
+        if keys is None:  # varbytes rows beyond the device prefix bound
+            return t.take(_host_sort_perm(
+                [t._columns[i] for i in cols_idx], asc))
         perm = _order.lexsort_indices(keys)
         return t.take(perm)
 
@@ -401,7 +406,8 @@ class Table:
         col = self._columns[i] if i is not None else column
         if self.row_mask is not None:
             valid = col.valid_mask() & self.emit_mask()
-            col = Column(col.data, col.dtype, valid, col.dictionary, col.name)
+            col = Column(col.data, col.dtype, valid, col.dictionary, col.name,
+                         varbytes=col.varbytes)
         # a sharded column's reduction already spans all shards (XLA
         # inserts the cross-chip all-reduce) — no distributed branch needed
         value = _aggregates.agg_scalar(col, op)
@@ -464,7 +470,19 @@ class Table:
         t = self
         out_cols = []
         for c in t._columns:
-            if c.is_string:
+            if c.is_varbytes:
+                if isinstance(other, str):
+                    if op == "eq":
+                        res = c.varbytes.equals_literal(other)
+                    elif op == "ne":
+                        res = ~c.varbytes.equals_literal(other)
+                    else:
+                        raise CylonError(
+                            Code.TypeError,
+                            "ordering vs str needs dictionary storage")
+                else:
+                    raise CylonError(Code.TypeError, "string col vs non-str")
+            elif c.is_string:
                 if isinstance(other, str):
                     code = np.searchsorted(c.dictionary, other)
                     hit = (code < len(c.dictionary)) and \
@@ -568,6 +586,39 @@ from ..util import capacity as _capacity
 from ..util import pow2 as _pow2  # shared capacity-rounding policy
 
 
+def _sort_keys_mixed(cols: Sequence[Column], asc: Sequence[bool]):
+    """Sort keys for a mix of plain and varbytes columns. Varbytes sort
+    lexicographically via big-endian prefix words + length (exact up to
+    strings.SORT_PREFIX_WORDS*4 bytes; longer → None, host fallback)."""
+    keys = []
+    for c, a in zip(cols, asc):
+        if c.is_varbytes:
+            if not c.varbytes.sortable_on_device:
+                return None
+            ks = c.varbytes.sort_prefix_keys()
+            if not a:
+                ks = [k ^ jnp.uint32(0xFFFFFFFF) for k in ks]
+            if c.validity is not None:
+                # nulls last: extreme on every prefix key
+                ext = jnp.uint32(0xFFFFFFFF)
+                ks = [jnp.where(c.validity, k, ext) for k in ks]
+            keys.extend(ks)
+        else:
+            keys.extend(_order.sort_keys([c], [a]))
+    return keys
+
+
+def _host_sort_perm(cols: Sequence[Column], asc: Sequence[bool]):
+    """Host lexsort fallback for varbytes rows past the device prefix
+    bound (>64-byte strings): decode only the SORT columns."""
+    import pandas as pd
+
+    df = pd.DataFrame({str(i): c.to_numpy() for i, c in enumerate(cols)})
+    perm = df.sort_values(by=[str(i) for i in range(len(cols))],
+                          ascending=list(asc), kind="stable").index.to_numpy()
+    return jnp.asarray(perm.astype(np.int32))
+
+
 def _resolve_join_columns(left: Table, right: Table, kwargs
                           ) -> Tuple[List[int], List[int]]:
     """pycylon's on=/left_on=/right_on= resolution (table.pyx:228-266)."""
@@ -603,7 +654,7 @@ def align_key_columns(left: Table, right: Table, lidx: List[int],
             raise CylonError(Code.TypeError,
                              f"join key type mismatch: {a.name} vs {b.name}")
         if a.is_string:
-            a, b = unify_dictionaries(a, b)
+            a, b = align_string_columns(a, b)
         elif a.data.dtype != b.data.dtype:
             common = jnp.promote_types(a.data.dtype, b.data.dtype)
             a = Column(a.data.astype(common), a.dtype, a.validity, None, a.name)
@@ -629,8 +680,12 @@ def row_gids(left: Table, right: Table) -> Tuple[jnp.ndarray, jnp.ndarray]:
     lcols, rcols = align_key_columns(left, right, lidx, lidx)
     keys_l, keys_r = [], []
     for a, b in zip(lcols, rcols):
-        keys_l.append(_order.sort_keys([a])[0])
-        keys_r.append(_order.sort_keys([b])[0])
+        if a.is_varbytes:
+            keys_l.extend(a.varbytes.hash_keys())
+            keys_r.extend(b.varbytes.hash_keys())
+        else:
+            keys_l.append(_order.sort_keys([a])[0])
+            keys_r.append(_order.sort_keys([b])[0])
         if a.validity is not None or b.validity is not None:
             keys_l.append(a.valid_mask().astype(jnp.uint8))
             keys_r.append(b.valid_mask().astype(jnp.uint8))
@@ -641,20 +696,43 @@ def row_gids(left: Table, right: Table) -> Tuple[jnp.ndarray, jnp.ndarray]:
 # Free-function operator API (reference: table.hpp:228-387)
 # ---------------------------------------------------------------------------
 
+def _expanded_keys(cols: Sequence[Column]):
+    """Key arrays for join/groupby kernels: one array per plain column,
+    (h1, h2, h3, len) content-hash arrays per varbytes column (its device
+    identity — data/strings.py)."""
+    keys, valids, flags = [], [], []
+    for c in cols:
+        if c.is_varbytes:
+            ks, vs, fs = string_key_arrays(c)
+            keys.extend(ks)
+            valids.extend(vs)
+            flags.extend(fs)
+        else:
+            keys.append(c.data)
+            valids.append(c.validity)
+            flags.append(c.is_string)
+    return tuple(keys), tuple(valids), tuple(flags)
+
+
 def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     """Local join (reference: cylon::Join, table.cpp:640-654). Exactly TWO
     compiled programs (count, then materialize) — only the 4 output-count
     scalars touch the host; the result keeps pow2 capacity with padding
-    rows masked via row_mask."""
+    rows masked via row_mask. Varbytes key columns join on their
+    content-hash identity; varbytes payload columns are re-gathered by
+    the materialized row indices (one varlen gather per column)."""
     lcols, rcols = align_key_columns(left, right, config.left_column_idx,
                                      config.right_column_idx)
-    str_flags = tuple(c.is_string for c in lcols)
-    lkeys = tuple(c.data for c in lcols)
-    lkvalid = tuple(c.validity for c in lcols)
-    rkeys = tuple(c.data for c in rcols)
-    rkvalid = tuple(c.validity for c in rcols)
+    # varbytes alignment may have lifted a dictionary key column: joins
+    # read keys from the ALIGNED columns, payload from the originals
+    lkeys, lkvalid, str_flags = _expanded_keys(lcols)
+    rkeys, rkvalid, _ = _expanded_keys(rcols)
     lemit, remit = left.row_mask, right.row_mask
 
+    # varbytes payload columns can't ride fixed-width gathers — they are
+    # re-gathered from the returned indices after materialize
+    lvb = [i for i, c in enumerate(left._columns) if c.is_varbytes]
+    rvb = [i for i, c in enumerate(right._columns) if c.is_varbytes]
     ldat = tuple(c.data for c in left._columns)
     lval = tuple(c.validity for c in left._columns)
     rdat = tuple(c.data for c in right._columns)
@@ -709,7 +787,7 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     elif use_hash:
         res = _stream_join(hash_mode=True)
     if res is not None:
-        lod, lov, rod, rov, emit = res
+        lod, lov, rod, rov, emit, lidx, ridx = res
     else:
         with _telemetry.phase("join.plan", seq):
             counts2, lo, m, bperm, un_mask = _join.plan_program(
@@ -722,7 +800,7 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
         aemit = remit if config.type == _join.JoinType.RIGHT else lemit
 
         with _telemetry.phase("join.materialize", seq):
-            lod, lov, rod, rov, emit = _join.materialize_program(
+            lod, lov, rod, rov, emit, lidx, ridx = _join.materialize_program(
                 lo, m, bperm, un_mask, aemit,
                 ldat, lval, rdat, rval, config.type, cap_p, cap_u)
 
@@ -731,6 +809,15 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
             for i, (d, v, c) in enumerate(zip(lod, lov, left._columns))]
     cols += [Column(d, c.dtype, v, c.dictionary, f"rt-{nl + j}")
              for j, (d, v, c) in enumerate(zip(rod, rov, right._columns))]
+    for i in lvb:
+        vb = left._columns[i].varbytes.take(lidx)
+        cols[i] = Column(vb.lengths, left._columns[i].dtype, cols[i].validity,
+                         None, cols[i].name, varbytes=vb)
+    for j in rvb:
+        vb = right._columns[j].varbytes.take(ridx)
+        cols[nl + j] = Column(vb.lengths, right._columns[j].dtype,
+                              cols[nl + j].validity, None, cols[nl + j].name,
+                              varbytes=vb)
     return Table(cols, left._ctx, emit)
 
 
@@ -741,7 +828,7 @@ def _aligned_setop_columns(left: Table, right: Table):
     for ci in range(left.column_count):
         a, b = left._columns[ci], right._columns[ci]
         if a.is_string:
-            a, b = unify_dictionaries(a, b)
+            a, b = align_string_columns(a, b)
         elif a.data.dtype != b.data.dtype:
             common = jnp.promote_types(a.data.dtype, b.data.dtype)
             a = a.astype(dtypes.from_np_dtype(common))
@@ -767,11 +854,16 @@ def set_op(left: Table, right: Table, op) -> Table:
     rows = _setops.setop_rows(gl, gr, left.emit_mask(), right.emit_mask(), op)
     out_cols = []
     for a, b in zip(lcols, rcols):
-        data = jnp.concatenate([a.data, b.data])
         validity = None
         if a.validity is not None or b.validity is not None:
             validity = jnp.concatenate([a.valid_mask(), b.valid_mask()])
-        merged = Column(data, a.dtype, validity, a.dictionary, a.name)
+        if a.is_varbytes:
+            merged = Column.from_varbytes(
+                concat_varbytes([a.varbytes, b.varbytes]), validity, a.name,
+                a.dtype)
+        else:
+            data = jnp.concatenate([a.data, b.data])
+            merged = Column(data, a.dtype, validity, a.dictionary, a.name)
         out_cols.append(merged.take(jnp.asarray(rows)))
     return Table(out_cols, left._ctx)
 
@@ -782,6 +874,15 @@ def concat_tables(tables: Sequence[Table], ctx: CylonContext) -> Table:
     out_cols = []
     for ci in range(first.column_count):
         cs = [t._columns[ci] for t in tables]
+        if any(c.is_varbytes for c in cs):
+            cs = [as_varbytes(c) for c in cs]
+            vb = concat_varbytes([c.varbytes for c in cs])
+            has_null = any(c.validity is not None for c in cs)
+            validity = jnp.concatenate([c.valid_mask() for c in cs]) \
+                if has_null else None
+            out_cols.append(Column.from_varbytes(vb, validity, cs[0].name,
+                                                 cs[0].dtype))
+            continue
         if cs[0].is_string:
             # unify all vocabularies pairwise-left-fold
             base = cs[0]
@@ -820,9 +921,23 @@ def groupby_local(table: Table, index_col, aggregate_cols: List,
     ops = [(_groupby.second_phase_op(o) if second_phase else o)
            for o in aggregate_ops]
 
+    for vi, op in zip(val_cols, ops):
+        if table._columns[vi].is_varbytes and \
+                op != _groupby.AggregationOp.COUNT:
+            raise CylonError(
+                Code.NotImplemented,
+                "varbytes value columns support COUNT only (MIN/MAX need "
+                "a total order the content-hash identity does not carry; "
+                "dictionary-encode the column for string MIN/MAX)")
     key_columns = [table._columns[i] for i in idx_cols]
-    keys = _order.sort_keys(key_columns)
+    keys = []
     for c in key_columns:
+        if c.is_varbytes:
+            # group identity = content hashes (grouping needs equality,
+            # not order)
+            keys.extend(c.varbytes.hash_keys())
+        else:
+            keys.extend(_order.sort_keys([c]))
         if c.validity is not None:
             keys.append(c.valid_mask().astype(jnp.uint8))
     emit = table.emit_mask()
@@ -848,7 +963,7 @@ def groupby_local(table: Table, index_col, aggregate_cols: List,
         g = table._columns[i].take(safe)
         validity = None if g.validity is None else g.validity & group_valid
         out_cols.append(Column(g.data, g.dtype, validity, g.dictionary,
-                               g.name))
+                               g.name, varbytes=g.varbytes))
     for (arr, avalid), vi, op in zip(results, val_cols, aggregate_ops):
         src = table._columns[vi]
         out_cols.append(Column(

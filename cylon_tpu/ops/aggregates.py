@@ -44,6 +44,26 @@ def agg_scalar(col: Column, op: str):
     valid = col.valid_mask()
     if op == "count":
         return int(_count(valid))
+    if col.is_varbytes:
+        # lexicographic min/max: one device sort of the prefix keys picks
+        # the winning ROW; only that row's bytes are decoded
+        vb = col.varbytes
+        if not vb.sortable_on_device:  # >64-byte rows: host fallback
+            vals = [v for v in col.to_numpy() if v is not None]
+            if not vals:
+                return None
+            return min(vals) if op == "min" else max(vals)
+        from .order import lexsort_indices
+
+        keys = vb.sort_prefix_keys()
+        if op == "max":
+            keys = [k ^ jnp.uint32(0xFFFFFFFF) for k in keys]
+        ext = jnp.uint32(0xFFFFFFFF)  # nulls lose either direction
+        keys = [jnp.where(valid, k, ext) for k in keys]
+        win = lexsort_indices(keys)[:1]
+        if not bool(jax.device_get(valid.any())):
+            return None
+        return str(vb.take(win).to_host()[0])
     if col.is_string:
         # min/max by dictionary order -> decode the code
         code = (_min if op == "min" else _max)(col.data, valid)

@@ -61,7 +61,14 @@ def fmix64(h: jnp.ndarray) -> jnp.ndarray:
 def hash_column(col: Column) -> jnp.ndarray:
     """Per-row uint32 hash of one column. Equal values hash equal (floats
     use the same -0.0-normalized bits as ordering; nulls hash to a fixed
-    tag)."""
+    tag). Varbytes strings hash their full byte content on device
+    (strings.VarBytes.hash_keys — the reference's BinaryHashPartitionKernel
+    analog, arrow_partition_kernels.hpp:94)."""
+    if col.is_varbytes:
+        h1, _h2, _h3, _ln = col.varbytes.hash_keys()
+        if col.validity is not None:
+            h1 = jnp.where(col.validity, h1, jnp.uint32(0x9E3779B9))
+        return h1
     bits = ordered_bits(col)
     if bits.dtype.itemsize == 8:
         h = fmix64(bits.astype(jnp.uint64))

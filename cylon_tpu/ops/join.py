@@ -683,6 +683,7 @@ def _materialize_program_stream_impl(counts, a_streams, b_streams,
         interpret=interpret)
     valid = aidx >= 0
     bhit = bidx >= 0
+    lidx, ridx = (bidx, aidx) if join_type == JoinType.RIGHT else (aidx, bidx)
 
     if join_type == JoinType.RIGHT:
         adat, aval, bdat, bval = rdat, rval, ldat, lval
@@ -715,7 +716,7 @@ def _materialize_program_stream_impl(counts, a_streams, b_streams,
         lod, lov, rod, rov = bod, bov, aod, aov
     else:
         lod, lov, rod, rov = aod, aov, bod, bov
-    return lod, lov, rod, rov, valid
+    return lod, lov, rod, rov, valid, lidx, ridx
 
 
 _materialize_program_stream_jit = partial(
@@ -777,7 +778,7 @@ def materialize_program(lo, m, bperm, un_mask, aemit,
         cap_p, cap_u)
     lod, lov = gather_columns(ldat, lval, lidx)
     rod, rov = gather_columns(rdat, rval, ridx)
-    return lod, lov, rod, rov, emit
+    return lod, lov, rod, rov, emit, lidx, ridx
 
 
 def gather_columns(dat, val, idx):

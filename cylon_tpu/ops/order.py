@@ -30,6 +30,12 @@ _WIDTH_UINT = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
 
 def ordered_bits(col: Column, descending: bool = False) -> jnp.ndarray:
     """Column wrapper over `ordered_bits_raw`."""
+    if col.is_varbytes:
+        # loud guard: a varbytes column has no single ordered-bits array —
+        # order needs sort_prefix_keys, equality needs hash_keys
+        raise CylonError(Code.TypeError,
+                         "varbytes columns need sort_prefix_keys/hash_keys, "
+                         "not ordered_bits")
     return ordered_bits_raw(col.data, col.is_string, descending)
 
 

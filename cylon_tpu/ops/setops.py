@@ -143,6 +143,10 @@ def setop_lane_descs(lcols, rcols):
     total = 0
     for a, b in zip(lcols, rcols):
         has_v = a.validity is not None or b.validity is not None
+        if a.is_varbytes or b.is_varbytes:
+            # varlen content can't ride (or be reconstructed from) fixed
+            # u32 lanes — dense-ranks path handles varbytes
+            return None
         if a.is_string:
             kind, slots = "d", 1
         elif a.data.dtype == jnp.bool_:

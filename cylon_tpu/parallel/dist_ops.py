@@ -23,7 +23,7 @@ gathered to the host.
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,15 +64,288 @@ def _table_payload(t: Table) -> dict:
     return p
 
 
+# ---------------------------------------------------------------------------
+# varbytes (device-native strings) distributed plumbing. A sharded
+# varbytes column is a SELF-CONTAINED per-shard layout (shard-relative
+# starts), so all content kernels run per shard; moving rows moves their
+# words through a SECOND exchange whose "rows" are words — the byte-count
+# matrix the reference's ArrowAllToAll length headers carry
+# (arrow_all_to_all.cpp:96-107) is exactly this word exchange's count
+# phase.
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=None)
+def _string_hash_fn(mesh, max_words: int):
+    """Per-shard content hashes (h1, h2, h3, len-as-u32) for a sharded
+    varbytes column — strings._hash_rows under shard_map (shard-relative
+    starts make the per-shard call exact)."""
+    from ..data import strings as _strings
+
+    spec = P(mesh.axis_names[0])
+
+    def kernel(words, starts, lengths):
+        h1, h2, h3 = _strings._hash_rows(words, starts, lengths, max_words)
+        return h1, h2, h3, lengths.astype(jnp.uint32)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec))
+
+
+def _dist_string_keys(ctx: CylonContext, col: Column):
+    """(h1, h2, h3, len) sharded key arrays for one varbytes column."""
+    vb = col.varbytes
+    return _string_hash_fn(ctx.mesh, vb.max_words)(
+        shard.pin(vb.words, ctx), shard.pin(vb.starts, ctx),
+        shard.pin(vb.lengths, ctx))
+
+
+def _dist_col_keys(ctx: CylonContext, c: Column):
+    """One column's (key bit arrays, partition hash): the content-hash
+    quad computes ONCE and serves both the key lanes and the partition
+    target (h1)."""
+    if c.is_varbytes:
+        q = _dist_string_keys(ctx, c)
+        h1 = q[0]
+        if c.validity is not None:
+            h1 = jnp.where(c.validity, h1, jnp.uint32(0x9E3779B9))
+        return list(q), h1
+    return [_order.sort_keys([c])[0]], _hash.hash_column(c)
+
+
+def _dist_key_bits(ctx: CylonContext, cols: Sequence[Column]):
+    """Key bit arrays, combined key-validity, and per-column partition
+    hashes for per-shard join/group kernels: ordered bits per plain
+    column, content-hash quads per varbytes column."""
+    bits: list = []
+    h1s: list = []
+    kv = None
+    for c in cols:
+        b, h1 = _dist_col_keys(ctx, c)
+        bits.extend(b)
+        h1s.append(h1)
+        v = c.valid_mask()
+        kv = v if kv is None else (kv & v)
+    return tuple(bits), kv, h1s
+
+
+def _targets_from_hashes(ctx: CylonContext, h1s: Sequence[jnp.ndarray]
+                         ) -> jnp.ndarray:
+    """Combine per-column row hashes into a shard target (the
+    ops/hash.hash_columns combine scheme)."""
+    world = ctx.get_world_size()
+    h = None
+    for hc in h1s:
+        h = hc if h is None else h * np.uint32(31) + hc
+    h = _hash.fmix32(h)
+    return (h % np.uint32(world)).astype(jnp.int32)
+
+
+def _partition_targets_dist(ctx: CylonContext, cols: Sequence[Column]
+                            ) -> jnp.ndarray:
+    """Per-row target shard for mixed plain/varbytes key columns. Plain
+    columns use the elementwise hash (sharding-transparent); varbytes
+    hash per shard."""
+    return _targets_from_hashes(
+        ctx, [_dist_col_keys(ctx, c)[1] for c in cols])
+
+
+@lru_cache(maxsize=None)
+def _word_targets_fn(mesh):
+    """Word-level (targets, emit) from row-level (targets, emit): every
+    word inherits its row's shuffle target; words of dead rows and slack
+    slots are dropped."""
+    from ..data import strings as _strings
+
+    spec = P(mesh.axis_names[0])
+
+    def kernel(words, starts, lengths, targets, emit):
+        W = words.shape[0]
+        nw = (lengths + 3) >> 2
+        row, p = _strings._word_row_map(starts, nw, W)
+        wt = jnp.take(targets, row)
+        wemit = jnp.take(emit, row) & (p >= 0) & (p < jnp.take(nw, row))
+        return wt.astype(jnp.int32), wemit
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 5,
+                             out_specs=spec))
+
+
+@lru_cache(maxsize=None)
+def _starts_fn(mesh):
+    """Rebuild per-shard packed starts from exchanged lengths."""
+    spec = P(mesh.axis_names[0])
+
+    def kernel(lengths):
+        nw = (lengths + 3) >> 2
+        return jnp.cumsum(nw) - nw
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec))
+
+
+def _exchange_varbytes_words(ctx: CylonContext, vb, targets, emit,
+                             new_lengths):
+    """The word-leg of a varbytes shuffle: words ride their own exchange
+    (stability of the bucket sort keeps word order == row order), then
+    shard-relative starts rebuild from the exchanged lengths."""
+    from ..data.strings import VarBytes
+
+    world = ctx.get_world_size()
+    wt, wemit = _word_targets_fn(ctx.mesh)(
+        shard.pin(vb.words, ctx), shard.pin(vb.starts, ctx),
+        shard.pin(vb.lengths, ctx), targets, emit)
+    wout, _wemit2, _wcap = exchange({"w": shard.pin(vb.words, ctx)},
+                                    wt, wemit, ctx)
+    new_starts = _starts_fn(ctx.mesh)(new_lengths)
+    return VarBytes(wout["w"], new_starts, new_lengths, vb.max_words,
+                    int(wout["w"].shape[0]),
+                    shard_geom=(int(new_lengths.shape[0]) // world,
+                                int(wout["w"].shape[0]) // world))
+
+
+def _exchange_table(t: Table, targets, emit, ctx: CylonContext,
+                    extra: Optional[dict] = None):
+    """Shuffle a whole table's columns (fixed-width AND varbytes) plus
+    optional extra per-row arrays. Returns (columns, new_emit,
+    extra_out)."""
+    payload = dict(extra or {})
+    for i, c in enumerate(t._columns):
+        payload[f"d{i}"] = c.data  # byte lengths for varbytes columns
+        payload[f"v{i}"] = c.valid_mask()
+    payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
+    out, new_emit, _cap = exchange(payload, targets, emit, ctx)
+    cols = []
+    for i, c in enumerate(t._columns):
+        d, v = out[f"d{i}"], out[f"v{i}"]
+        if c.is_varbytes:
+            vb = _exchange_varbytes_words(ctx, c.varbytes, targets, emit, d)
+            cols.append(Column(vb.lengths, c.dtype, v, None, c.name,
+                               varbytes=vb))
+        else:
+            cols.append(Column(d, c.dtype, v, c.dictionary, c.name))
+    extra_out = {k: out[k] for k in (extra or {})}
+    return cols, new_emit, extra_out
+
+
+# -- per-shard varlen gather (count → take at worst-shard capacity) --
+
+
+@lru_cache(maxsize=None)
+def _varlen_count_fn(mesh, replicated: bool = False):
+    """Output-word count for a per-shard varlen gather. ``replicated``:
+    the length source is a replicated (vocab) array, idx stays sharded."""
+    axis = mesh.axis_names[0]
+    spec = P(axis)
+
+    def kernel(lengths, idx):
+        safe = jnp.maximum(idx, 0)
+        nw = (jnp.take(lengths, safe) + 3) >> 2
+        total = jnp.where(idx >= 0, nw, 0).sum().astype(jnp.int32)
+        return replicated_gather(total[None], axis, mesh.devices.size)
+
+    src = P() if replicated else spec
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(src, spec),
+                             out_specs=P()))
+
+
+@lru_cache(maxsize=None)
+def _varlen_take_fn(mesh, cap_w: int, replicated: bool = False):
+    """Per-shard varlen gather (strings._take_program under shard_map).
+    ``replicated``: gather FROM a replicated source (dict vocab lift)."""
+    from ..data import strings as _strings
+
+    spec = P(mesh.axis_names[0])
+
+    def kernel(words, starts, lengths, idx):
+        return _strings._take_program(words, starts, lengths, idx, cap_w)
+
+    src = P() if replicated else spec
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(src, src, src, spec),
+                             out_specs=spec))
+
+
+def _varlen_take_sharded(ctx: CylonContext, vb, idx) -> "object":
+    """Distributed analog of VarBytes.take: per-shard varlen gather with
+    ONE host sync for the worst shard's output word count."""
+    from ..data.strings import VarBytes
+
+    words = shard.pin(vb.words, ctx)
+    starts = shard.pin(vb.starts, ctx)
+    lengths = shard.pin(vb.lengths, ctx)
+    idx = shard.pin(idx, ctx)
+    counts = np.asarray(jax.device_get(
+        _varlen_count_fn(ctx.mesh)(lengths, idx)))
+    cap_w = _capacity(max(int(counts.max()), 1))
+    w, s, ln = _varlen_take_fn(ctx.mesh, cap_w)(words, starts, lengths, idx)
+    world = ctx.get_world_size()
+    return VarBytes(w, s, ln, vb.max_words, int(w.shape[0]),
+                    shard_geom=(int(idx.shape[0]) // world, cap_w))
+
+
+def _dist_as_varbytes(ctx: CylonContext, col: Column) -> Column:
+    """Sharding-aware as_varbytes: dictionary codes stay sharded; the
+    (small) vocab VarBytes is replicated and each shard gathers its own
+    self-contained layout."""
+    from ..data.strings import VarBytes
+
+    if col.is_varbytes:
+        return col
+    vocab_vb = VarBytes.from_host(col.dictionary)
+    max_words = vocab_vb.max_words
+    codes = shard.pin(col.data, ctx)
+    counts = np.asarray(jax.device_get(
+        _varlen_count_fn(ctx.mesh, replicated=True)(
+            jax.device_put(vocab_vb.lengths), codes)))
+    cap_w = _capacity(max(int(counts.max()), 1))
+    w, s, ln = _varlen_take_fn(ctx.mesh, cap_w, replicated=True)(
+        vocab_vb.words, vocab_vb.starts, vocab_vb.lengths, codes)
+    world = ctx.get_world_size()
+    vb = VarBytes(w, s, ln, max_words, int(w.shape[0]),
+                  shard_geom=(int(codes.shape[0]) // world, cap_w))
+    return Column(vb.lengths, col.dtype, col.validity, None, col.name,
+                  varbytes=vb)
+
+
+def _align_key_columns_dist(ctx: CylonContext, left_d: Table,
+                            right_d: Table, lidx, ridx):
+    """Distribution-aware align_key_columns: mixed string storages lift
+    through the replicated-vocab kernel (the eager lift in
+    data/column.align_string_columns would collapse per-shard layouts)."""
+    lcols, rcols = [], []
+    for li, ri in zip(lidx, ridx):
+        a, b = left_d._columns[li], right_d._columns[ri]
+        if a.is_string != b.is_string:
+            raise CylonError(Code.TypeError,
+                             f"join key type mismatch: {a.name} vs {b.name}")
+        if a.is_string:
+            if a.is_varbytes or b.is_varbytes:
+                a = _dist_as_varbytes(ctx, a)
+                b = _dist_as_varbytes(ctx, b)
+            else:
+                a, b = unify_dictionaries(a, b)
+        elif a.data.dtype != b.data.dtype:
+            common = jnp.promote_types(a.data.dtype, b.data.dtype)
+            a = Column(a.data.astype(common), a.dtype, a.validity, None,
+                       a.name)
+            b = Column(b.data.astype(common), b.dtype, b.validity, None,
+                       b.name)
+        lcols.append(a)
+        rcols.append(b)
+    return lcols, rcols
+
+
 def _payload_tuples(p: dict, ncols: int) -> Tuple[Tuple, Tuple]:
     return (tuple(p[f"d{i}"] for i in range(ncols)),
             tuple(p[f"v{i}"] for i in range(ncols)))
 
 
-def _rebuild_columns(dat: Sequence, val: Sequence, src: Table,
+def _rebuild_columns(dat: Sequence, val: Sequence, src,
                      names: Sequence[str]) -> List[Column]:
+    src_cols = src._columns if isinstance(src, Table) else src
     cols = []
-    for d, v, c, name in zip(dat, val, src._columns, names):
+    for d, v, c, name in zip(dat, val, src_cols, names):
         cols.append(Column(d, c.dtype, v, c.dictionary, name))
     return cols
 
@@ -120,7 +393,7 @@ def _join_mat_fn(mesh, join_type: _join.JoinType, cap_p: int, cap_u: int):
             lo, m, bperm, un_mask, aemit, join_type, cap_p, cap_u)
         lod, lov = _gather_side(ldat, lval, lidx)
         rod, rov = _gather_side(rdat, rval, ridx)
-        return lod, lov, rod, rov, emit
+        return lod, lov, rod, rov, emit, lidx, ridx
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 9,
                              out_specs=spec))
@@ -154,9 +427,47 @@ def _setop_mat_fn(mesh, op: _setops.SetOp, cap: int):
         dat = tuple(jnp.concatenate([a, b]) for a, b in zip(ldat, rdat))
         val = tuple(jnp.concatenate([a, b]) for a, b in zip(lval, rval))
         od, ov = _gather_side(dat, val, idx)
-        return od, ov, emit
+        return od, ov, emit, idx
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 8,
+                             out_specs=spec))
+
+
+@lru_cache(maxsize=None)
+def _varlen_take_concat_count_fn(mesh):
+    """Word count for a gather over the per-shard concat [left; right]
+    varbytes pair."""
+    axis = mesh.axis_names[0]
+    spec = P(axis)
+
+    def kernel(ll, lr, idx):
+        lens = jnp.concatenate([ll, lr])
+        safe = jnp.maximum(idx, 0)
+        nw = (jnp.take(lens, safe) + 3) >> 2
+        total = jnp.where(idx >= 0, nw, 0).sum().astype(jnp.int32)
+        return replicated_gather(total[None], axis, mesh.devices.size)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=P()))
+
+
+@lru_cache(maxsize=None)
+def _varlen_take_concat_fn(mesh, cap_w: int):
+    """Varlen gather over the per-shard concat of two varbytes columns.
+    The source concat needs NO repacking: right starts shift by the
+    (static) left word-buffer length — the hash/take range sums are
+    gap-immune (data/strings.py)."""
+    from ..data import strings as _strings
+
+    spec = P(mesh.axis_names[0])
+
+    def kernel(lw, ls, ll, rw, rs, rl, idx):
+        words = jnp.concatenate([lw, rw])
+        starts = jnp.concatenate([ls, rs + lw.shape[0]])
+        lens = jnp.concatenate([ll, rl])
+        return _strings._take_program(words, starts, lens, idx, cap_w)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 7,
                              out_specs=spec))
 
 
@@ -174,7 +485,7 @@ def _groupby_fn(mesh, ops: Tuple[_groupby.AggregationOp, ...]):
         kout = tuple(jnp.take(d, safe, axis=0) for d in kdat)
         kvout = tuple(jnp.take(v, safe) & gvalid for v in kval)
         agg = tuple((arr, av & gvalid) for arr, av in results)
-        return kout, kvout, gvalid, agg
+        return kout, kvout, gvalid, agg, safe
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
                              out_specs=spec))
@@ -199,13 +510,10 @@ def shuffle(table: Table, hash_columns: Sequence) -> Table:
                                     world)
     if sig is not None and t._hash_partitioned == sig:
         return t
-    targets = shard.pin(_hash.partition_targets(
-        [t._columns[i] for i in idxs], world), ctx)
+    targets = shard.pin(_partition_targets_dist(
+        ctx, [t._columns[i] for i in idxs]), ctx)
     emit = shard.pin(t.emit_mask(), ctx)
-    payload = {k: shard.pin(v, ctx) for k, v in _table_payload(t).items()}
-    out, new_emit, _cap = exchange(payload, targets, emit, ctx)
-    dat, val = _payload_tuples(out, t.column_count)
-    cols = _rebuild_columns(dat, val, t, t.column_names)
+    cols, new_emit, _x = _exchange_table(t, targets, emit, ctx)
     result = Table(cols, ctx, new_emit)
     result._hash_partitioned = sig
     # reference parity: Shuffle frees non-retained inputs (table.cpp:207)
@@ -245,11 +553,9 @@ def repartition(table: Table, ctx: CylonContext) -> Table:
     n = t.capacity
     targets = shard.pin(
         jnp.arange(n, dtype=jnp.int32) % world, ctx)
-    payload = {k: shard.pin(v, ctx) for k, v in _table_payload(t).items()}
-    out, new_emit, _ = exchange(payload, targets, shard.pin(t.emit_mask(), ctx),
-                                ctx)
-    dat, val = _payload_tuples(out, t.column_count)
-    return Table(_rebuild_columns(dat, val, t, t.column_names), ctx, new_emit)
+    cols, new_emit, _x = _exchange_table(
+        t, targets, shard.pin(t.emit_mask(), ctx), ctx)
+    return Table(cols, ctx, new_emit)
 
 
 # ---------------------------------------------------------------------------
@@ -268,38 +574,36 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig
     left_d = shard.distribute(left, ctx)
     right_d = shard.distribute(right, ctx)
     lidx, ridx = config.left_column_idx, config.right_column_idx
-    lcols, rcols = table_mod.align_key_columns(left_d, right_d, lidx, ridx)
+    lcols, rcols = _align_key_columns_dist(ctx, left_d, right_d, lidx, ridx)
 
     seq = ctx.get_next_sequence()
     shuffled = []
     with _phase("distributed_join.shuffle", seq):
         for t, kcols, kidx in ((left_d, lcols, lidx), (right_d, rcols, ridx)):
-            bits = _order.sort_keys(kcols)
-            kv = _all_valid(kcols)
+            bits, kv, h1s = _dist_key_bits(ctx, kcols)
             sig = shard.partition_signature(kcols, kidx, world)
             if sig is not None and t._hash_partitioned == sig:
                 # co-partitioned (prior shuffle or distribute_by_key host
                 # ingest): rows are already hash-placed — skip the exchange
-                dat = tuple(shard.pin(c.data, ctx) for c in t._columns)
-                val = tuple(shard.pin(c.valid_mask(), ctx)
-                            for c in t._columns)
                 shuffled.append((tuple(shard.pin(b, ctx) for b in bits),
                                  shard.pin(kv, ctx),
-                                 shard.pin(t.emit_mask(), ctx), dat, val))
+                                 shard.pin(t.emit_mask(), ctx), t._columns))
                 continue
-            targets = shard.pin(_hash.partition_targets(kcols, world), ctx)
-            payload = _table_payload(t)
-            for j, b in enumerate(bits):
-                payload[f"k{j}"] = b
-            payload["kv"] = kv
-            payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
-            out, emit, _cap = exchange(payload, targets,
-                                       shard.pin(t.emit_mask(), ctx), ctx)
-            kbits = tuple(out[f"k{j}"] for j in range(len(bits)))
-            dat, val = _payload_tuples(out, t.column_count)
-            shuffled.append((kbits, out["kv"], emit, dat, val))
+            targets = shard.pin(_targets_from_hashes(ctx, h1s), ctx)
+            extra = {f"k{j}": b for j, b in enumerate(bits)}
+            extra["kv"] = kv
+            cols, emit, xout = _exchange_table(
+                t, targets, shard.pin(t.emit_mask(), ctx), ctx, extra)
+            kbits = tuple(xout[f"k{j}"] for j in range(len(bits)))
+            shuffled.append((kbits, xout["kv"], emit, cols))
 
-    (lkb, lkv, lemit, ldat, lval), (rkb, rkv, remit, rdat, rval) = shuffled
+    (lkb, lkv, lemit, lcols_s), (rkb, rkv, remit, rcols_s) = shuffled
+    lvb = [i for i, c in enumerate(lcols_s) if c.is_varbytes]
+    rvb = [i for i, c in enumerate(rcols_s) if c.is_varbytes]
+    ldat = tuple(shard.pin(c.data, ctx) for c in lcols_s)
+    lval = tuple(shard.pin(c.valid_mask(), ctx) for c in lcols_s)
+    rdat = tuple(shard.pin(c.data, ctx) for c in rcols_s)
+    rval = tuple(shard.pin(c.valid_mask(), ctx) for c in rcols_s)
 
     jt = config.type
     with _phase("distributed_join.plan", seq):
@@ -315,14 +619,26 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig
         if jt == _join.JoinType.FULL_OUTER else 0
 
     with _phase("distributed_join.materialize", seq):
-        lod, lov, rod, rov, emit = _join_mat_fn(ctx.mesh, jt, cap_p, cap_u)(
+        lod, lov, rod, rov, emit, lidx_o, ridx_o = _join_mat_fn(
+            ctx.mesh, jt, cap_p, cap_u)(
             lo, m, bperm, un_mask, aemit, ldat, lval, rdat, rval)
 
     nl = left_d.column_count
-    cols = _rebuild_columns(lod, lov, left_d,
+    cols = _rebuild_columns(lod, lov, lcols_s,
                             [f"lt-{i}" for i in range(nl)])
-    cols += _rebuild_columns(rod, rov, right_d,
+    cols += _rebuild_columns(rod, rov, rcols_s,
                              [f"rt-{nl + j}" for j in range(right_d.column_count)])
+    # varbytes payload columns: per-shard varlen gather by the
+    # materialized indices (fixed-width lanes carried only the lengths)
+    for i in lvb:
+        vb = _varlen_take_sharded(ctx, lcols_s[i].varbytes, lidx_o)
+        cols[i] = Column(vb.lengths, lcols_s[i].dtype, cols[i].validity,
+                         None, cols[i].name, varbytes=vb)
+    for j in rvb:
+        vb = _varlen_take_sharded(ctx, rcols_s[j].varbytes, ridx_o)
+        cols[nl + j] = Column(vb.lengths, rcols_s[j].dtype,
+                              cols[nl + j].validity, None,
+                              cols[nl + j].name, varbytes=vb)
     result = Table(cols, ctx, emit)
     left._free_if_unretained()
     right._free_if_unretained()
@@ -478,7 +794,9 @@ def distributed_join_ring(left: Table, right: Table,
     ctx = left._ctx
     world = ctx.get_world_size()
     jt = config.type
-    if world == 1 or jt == _join.JoinType.FULL_OUTER:
+    if world == 1 or jt == _join.JoinType.FULL_OUTER or \
+            any(c.is_varbytes for c in left._columns + right._columns):
+        # varbytes payload can't ride the ring's fixed-width rotation yet
         return distributed_join(left, right, config)
 
     left_d = shard.distribute(left, ctx)
@@ -552,7 +870,8 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
     left_d = shard.distribute(left, ctx)
     right_d = shard.distribute(right, ctx)
     all_idx = list(range(left_d.column_count))
-    lcols, rcols = table_mod.align_key_columns(left_d, right_d, all_idx, all_idx)
+    lcols, rcols = _align_key_columns_dist(ctx, left_d, right_d,
+                                           all_idx, all_idx)
 
     has_validity = [a.validity is not None or b.validity is not None
                     for a, b in zip(lcols, rcols)]
@@ -560,29 +879,35 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
     seq = ctx.get_next_sequence()
     shuffled = []
     with _phase("distributed_set_op.shuffle", seq):
-        for cols in (lcols, rcols):
-            t_emit = (left_d if cols is lcols else right_d).emit_mask()
-            targets = shard.pin(_hash.partition_targets(cols, world), ctx)
-            payload = {}
+        for cols, t in ((lcols, left_d), (rcols, right_d)):
+            # aligned key columns ARE the payload for set ops; wrap them
+            # in a view table so _exchange_table moves varbytes content
+            view = Table(list(cols), ctx, t.row_mask)
+            extra = {}
             nbits = 0
+            h1s = []
             for ci, c in enumerate(cols):
-                payload[f"d{ci}"] = c.data
-                payload[f"v{ci}"] = c.valid_mask()
-                payload[f"k{nbits}"] = _order.sort_keys([c])[0]
-                nbits += 1
+                b, h1 = _dist_col_keys(ctx, c)
+                h1s.append(h1)
+                for arr in b:
+                    extra[f"k{nbits}"] = arr
+                    nbits += 1
                 if has_validity[ci]:
                     # validity participates in the row key (nulls compare
                     # equal, matching the reference's set-distinct semantics)
-                    payload[f"k{nbits}"] = c.valid_mask().astype(jnp.uint8)
+                    extra[f"k{nbits}"] = c.valid_mask().astype(jnp.uint8)
                     nbits += 1
-            payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
-            out, emit, _cap = exchange(payload, targets,
-                                       shard.pin(t_emit, ctx), ctx)
-            kbits = tuple(out[f"k{j}"] for j in range(nbits))
-            dat, val = _payload_tuples(out, len(cols))
-            shuffled.append((kbits, emit, dat, val))
+            targets = shard.pin(_targets_from_hashes(ctx, h1s), ctx)
+            out_cols, emit, xout = _exchange_table(
+                view, targets, shard.pin(t.emit_mask(), ctx), ctx, extra)
+            kbits = tuple(xout[f"k{j}"] for j in range(nbits))
+            shuffled.append((kbits, emit, out_cols))
 
-    (lkb, lemit, ldat, lval), (rkb, remit, rdat, rval) = shuffled
+    (lkb, lemit, lcols_s), (rkb, remit, rcols_s) = shuffled
+    ldat = tuple(shard.pin(c.data, ctx) for c in lcols_s)
+    lval = tuple(shard.pin(c.valid_mask(), ctx) for c in lcols_s)
+    rdat = tuple(shard.pin(c.data, ctx) for c in rcols_s)
+    rval = tuple(shard.pin(c.valid_mask(), ctx) for c in rcols_s)
 
     with _phase("distributed_set_op.count", seq):
         counts = np.asarray(jax.device_get(_setop_count_fn(ctx.mesh)(
@@ -591,12 +916,34 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
     cap = _capacity(int(total.max()))
 
     with _phase("distributed_set_op.materialize", seq):
-        od, ov, emit = _setop_mat_fn(ctx.mesh, op, cap)(
+        od, ov, emit, idx = _setop_mat_fn(ctx.mesh, op, cap)(
             lkb, lemit, rkb, remit, ldat, lval, rdat, rval)
 
+    from ..data.strings import VarBytes
+
     cols = []
-    for d, v, a in zip(od, ov, lcols):
-        cols.append(Column(d, a.dtype, v, a.dictionary, a.name))
+    for ci, (d, v, a) in enumerate(zip(od, ov, lcols_s)):
+        if a.is_varbytes:
+            bvb = rcols_s[ci].varbytes
+            wcounts = np.asarray(jax.device_get(
+                _varlen_take_concat_count_fn(ctx.mesh)(
+                    shard.pin(a.varbytes.lengths, ctx),
+                    shard.pin(bvb.lengths, ctx), idx)))
+            cap_w = _capacity(max(int(wcounts.max()), 1))
+            w, s, ln = _varlen_take_concat_fn(ctx.mesh, cap_w)(
+                shard.pin(a.varbytes.words, ctx),
+                shard.pin(a.varbytes.starts, ctx),
+                shard.pin(a.varbytes.lengths, ctx),
+                shard.pin(bvb.words, ctx), shard.pin(bvb.starts, ctx),
+                shard.pin(bvb.lengths, ctx), idx)
+            vb = VarBytes(w, s, ln,
+                          max(a.varbytes.max_words, bvb.max_words),
+                          int(w.shape[0]),
+                          shard_geom=(int(idx.shape[0]) // world, cap_w))
+            cols.append(Column(vb.lengths, a.dtype, v, None, a.name,
+                               varbytes=vb))
+        else:
+            cols.append(Column(d, a.dtype, v, a.dictionary, a.name))
     return Table(cols, ctx, emit)
 
 
@@ -621,38 +968,53 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
     idx_cols = [t._col_index(c) for c in idx_cols]
     val_cols = [t._col_index(c) for c in aggregate_cols]
     key_columns = [t._columns[i] for i in idx_cols]
+    for vi, op in zip(val_cols, aggregate_ops):
+        if t._columns[vi].is_varbytes and \
+                op != _groupby.AggregationOp.COUNT:
+            raise CylonError(Code.NotImplemented,
+                             "varbytes value columns support COUNT only")
 
     seq = ctx.get_next_sequence()
     with _phase("distributed_groupby.shuffle", seq):
-        targets = shard.pin(_hash.partition_targets(key_columns, world), ctx)
-        payload = {}
-        for j, c in enumerate(key_columns):
-            payload[f"kb{j}"] = _order.sort_keys([c])[0]
-            payload[f"kd{j}"] = c.data
-            payload[f"kv{j}"] = c.valid_mask()
-        for j, vi in enumerate(val_cols):
-            payload[f"d{j}"] = t._columns[vi].data
-            payload[f"v{j}"] = t._columns[vi].valid_mask()
-        payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
-        out, emit, _cap = exchange(payload, targets,
-                                   shard.pin(t.emit_mask(), ctx), ctx)
+        # key+value columns ride one exchange as a view table; key bit
+        # lanes (hash quads for varbytes) ride as extra payload
+        view_cols = key_columns + [t._columns[vi] for vi in val_cols]
+        view = Table(list(view_cols), ctx, t.row_mask)
+        extra = {}
+        nbits = 0
+        h1s = []
+        for c in key_columns:
+            b, h1 = _dist_col_keys(ctx, c)
+            h1s.append(h1)
+            for arr in b:
+                extra[f"kb{nbits}"] = arr
+                nbits += 1
+        targets = shard.pin(_targets_from_hashes(ctx, h1s), ctx)
+        out_cols, emit, xout = _exchange_table(
+            view, targets, shard.pin(t.emit_mask(), ctx), ctx, extra)
 
     nk, nv = len(idx_cols), len(val_cols)
-    kbits = tuple(out[f"kb{j}"] for j in range(nk))
-    kdat = tuple(out[f"kd{j}"] for j in range(nk))
-    kval = tuple(out[f"kv{j}"] for j in range(nk))
-    vdat = tuple(out[f"d{j}"] for j in range(nv))
-    vval = tuple(out[f"v{j}"] for j in range(nv))
+    kcols_s = out_cols[:nk]
+    vcols_s = out_cols[nk:]
+    kbits = tuple(xout[f"kb{j}"] for j in range(nbits))
+    kdat = tuple(shard.pin(c.data, ctx) for c in kcols_s)
+    kval = tuple(shard.pin(c.valid_mask(), ctx) for c in kcols_s)
+    vdat = tuple(shard.pin(c.data, ctx) for c in vcols_s)
+    vval = tuple(shard.pin(c.valid_mask(), ctx) for c in vcols_s)
 
     ops = tuple(aggregate_ops)
     with _phase("distributed_groupby.aggregate", seq):
-        kout, kvout, gvalid, agg = _groupby_fn(ctx.mesh, ops)(
+        kout, kvout, gvalid, agg, safe = _groupby_fn(ctx.mesh, ops)(
             kbits, kdat, kval, emit, vdat, vval)
 
     cols = []
-    for d, v, src_i in zip(kout, kvout, idx_cols):
-        src = t._columns[src_i]
-        cols.append(Column(d, src.dtype, v, src.dictionary, src.name))
+    for d, v, kc in zip(kout, kvout, kcols_s):
+        if kc.is_varbytes:
+            vb = _varlen_take_sharded(ctx, kc.varbytes, safe)
+            cols.append(Column(vb.lengths, kc.dtype, v, None, kc.name,
+                               varbytes=vb))
+        else:
+            cols.append(Column(d, kc.dtype, v, kc.dictionary, kc.name))
     for (arr, av), vi, op in zip(agg, val_cols, aggregate_ops):
         src = t._columns[vi]
         keep_dict = (op in (_groupby.AggregationOp.MIN,
@@ -671,6 +1033,11 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
 
 def distributed_sort(table: Table, order_by, ascending=True) -> Table:
     ctx = table._ctx
+    if any(c.is_varbytes for c in table._columns):
+        raise CylonError(
+            Code.NotImplemented,
+            "distributed_sort on varbytes columns needs the cross-shard "
+            "varlen gather; dictionary-encode, or sort locally per shard")
     t = shard.distribute(table, ctx) if ctx.is_distributed() else table
     by = order_by if isinstance(order_by, (list, tuple)) else [order_by]
     idxs = [t._col_index(c) for c in by]

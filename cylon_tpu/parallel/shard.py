@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..context import CylonContext
 from ..data.column import Column
 from ..data.table import Table
+from ..status import Code, CylonError
 
 # Per-shard capacities are rounded to a multiple of 8 (TPU sublane quantum)
 _ROW_QUANTUM = 8
@@ -93,6 +94,9 @@ def distribute(table: Table, ctx: CylonContext) -> Table:
 
     cols = []
     for c in table._columns:
+        if c.is_varbytes:
+            cols.append(_distribute_varbytes(c, n, cap, world, sharding))
+            continue
         data = jax.device_put(_pad_to(c.data, total, 0), sharding)
         validity = None
         if c.validity is not None:
@@ -100,6 +104,52 @@ def distribute(table: Table, ctx: CylonContext) -> Table:
         cols.append(Column(data, c.dtype, validity, c.dictionary, c.name))
     mask = jax.device_put(_pad_to(table.emit_mask(), total, False), sharding)
     return Table(cols, ctx, mask)
+
+
+def _distribute_varbytes(c: Column, n: int, cap: int, world: int,
+                         sharding) -> Column:
+    """Shard a varbytes column: each shard gets a SELF-CONTAINED local
+    (words, starts, lengths) layout — starts are shard-relative word
+    indices, so per-shard kernels (hash, take) run with no cross-shard
+    word addressing. Shards' word buffers pad to a common capacity."""
+    from ..data.strings import VarBytes
+    from ..util import capacity as _capacity
+
+    vb = c.varbytes
+    # one device_get + numpy slicing + one device_put: each shard's rows
+    # are a CONTIGUOUS row range, so its words are a contiguous slice of
+    # the source buffer (monotone starts) — no per-shard device gathers
+    words_h = np.asarray(jax.device_get(vb.words))
+    starts_h = np.asarray(jax.device_get(vb.eff_starts()))
+    lens_h = np.asarray(jax.device_get(vb.lengths))
+    nw_h = (lens_h.astype(np.int64) + 3) // 4
+    slices = []
+    for s in range(world):
+        lo, hi = s * cap, min((s + 1) * cap, n)
+        if lo >= hi:
+            slices.append((0, 0, lo, hi))
+            continue
+        w_lo = int(starts_h[lo])
+        w_hi = int(starts_h[hi - 1] + nw_h[hi - 1])
+        slices.append((w_lo, w_hi, lo, hi))
+    wc = _capacity(max(max(w_hi - w_lo for w_lo, w_hi, _l, _h in slices), 1))
+    words = np.zeros(world * wc, np.uint32)
+    starts = np.zeros(world * cap, np.int32)
+    lengths = np.zeros(world * cap, np.int32)
+    for s, (w_lo, w_hi, lo, hi) in enumerate(slices):
+        words[s * wc: s * wc + (w_hi - w_lo)] = words_h[w_lo:w_hi]
+        starts[s * cap: s * cap + (hi - lo)] = starts_h[lo:hi] - w_lo
+        lengths[s * cap: s * cap + (hi - lo)] = lens_h[lo:hi]
+    out_vb = VarBytes(jax.device_put(jnp.asarray(words), sharding),
+                      jax.device_put(jnp.asarray(starts), sharding),
+                      jax.device_put(jnp.asarray(lengths), sharding),
+                      vb.max_words, world * wc, shard_geom=(cap, wc))
+    validity = None
+    if c.validity is not None:
+        validity = jax.device_put(
+            _pad_to(c.validity, world * cap, False), sharding)
+    return Column(out_vb.lengths, c.dtype, validity, None, c.name,
+                  varbytes=out_vb)
 
 
 def distribute_array(arr, n_src_rows: int, ctx: CylonContext,
@@ -132,6 +182,12 @@ def host_partition_arrays(t: Table, idxs, world: int):
     lives in exactly one place."""
     from .. import native as _native
 
+    for c in t._columns:
+        if c.is_varbytes:
+            raise CylonError(
+                Code.NotImplemented,
+                "host partitioner on varbytes columns: dictionary-encode "
+                "or use the device shuffle (distributed_join/shuffle)")
     host = [np.asarray(jax.device_get(c.data)) for c in t._columns]
     valids = [None if c.validity is None
               else np.asarray(jax.device_get(c.valid_mask()))
@@ -198,13 +254,16 @@ def assemble_process_local(tables, ctx: CylonContext) -> Table:
     ragged; shards are padded to the global max (agreed via a tiny
     all-gathered count exchange) and the padding is masked dead.
 
-    Limitation: dictionary-encoded (string) columns would need a global
-    vocabulary unification across processes; they are rejected here for
-    now.
+    String columns are lifted to device-native varbytes storage
+    (data/strings.py): content hashes need NO global vocabulary, so
+    every process ingests its strings independently — the reference's
+    per-rank binary columns (arrow_partition_kernels.hpp:94) with zero
+    cross-process coordination beyond the word-capacity agreement.
     """
     from jax.experimental import multihost_utils
 
-    from ..status import Code, CylonError
+    from ..data.column import as_varbytes
+    from ..util import capacity as _capacity
 
     local = ctx.local_shard_indices()
     if len(tables) != len(local):
@@ -212,35 +271,41 @@ def assemble_process_local(tables, ctx: CylonContext) -> Table:
             Code.Invalid,
             f"need one table per local shard ({len(local)}), got {len(tables)}")
     tables = [t.compact() for t in tables]
-    for t in tables:
-        for c in t._columns:
-            if c.dictionary is not None:
-                raise CylonError(
-                    Code.NotImplemented,
-                    "string columns need global vocab unification; "
-                    "multi-host ingest supports fixed-width columns only")
 
-    counts = np.array([t.capacity for t in tables], np.int64)
+    first = tables[0]
+    vb_cols = [ci for ci in range(first.column_count)
+               if any(t._columns[ci].is_string for t in tables)]
+    # lift once; the counts matrix AND the buffer assembly reuse these
+    lifted = {ci: [as_varbytes(t._columns[ci]) for t in tables]
+              for ci in vb_cols}
+
+    # rows AND per-string-column word counts agree via one allgather
+    counts = np.array(
+        [[t.capacity for t in tables]]
+        + [[c.varbytes.total_words for c in lifted[ci]]
+           for ci in vb_cols], np.int64)
     if ctx.get_process_count() > 1:
-        all_counts = np.asarray(
-            multihost_utils.process_allgather(counts)).reshape(-1)
+        all_counts = np.asarray(multihost_utils.process_allgather(
+            counts.T.copy())).reshape(-1, counts.shape[0]).T
     else:
         all_counts = counts
-    cap = -(-int(all_counts.max()) // _ROW_QUANTUM) * _ROW_QUANTUM
+    cap = -(-int(all_counts[0].max()) // _ROW_QUANTUM) * _ROW_QUANTUM
     cap = max(cap, _ROW_QUANTUM)
+    word_caps = {ci: _capacity(max(int(all_counts[1 + k].max()), 1))
+                 for k, ci in enumerate(vb_cols)}
 
     sharding = row_sharding(ctx)
     world = ctx.get_world_size()
-    first = tables[0]
 
-    def build(arrays, fill):
-        """Pad each local shard's array to [cap], stack, and lift to the
-        global [world*cap] array."""
+    def build(arrays, fill, pad_len=None):
+        """Pad each local shard's array to a common length, stack, and
+        lift to the global sharded array."""
+        tgt = cap if pad_len is None else pad_len
         blocks = []
         for arr in arrays:
             a = np.asarray(arr)
-            if a.shape[0] < cap:
-                pad = np.full((cap - a.shape[0],) + a.shape[1:], fill,
+            if a.shape[0] < tgt:
+                pad = np.full((tgt - a.shape[0],) + a.shape[1:], fill,
                               a.dtype)
                 a = np.concatenate([a, pad])
             blocks.append(a)
@@ -248,11 +313,36 @@ def assemble_process_local(tables, ctx: CylonContext) -> Table:
         if ctx.get_process_count() == 1:
             return jax.device_put(jnp.asarray(local_np), sharding)
         return jax.make_array_from_process_local_data(
-            sharding, local_np, (world * cap,) + local_np.shape[1:])
+            sharding, local_np, (world * tgt,) + local_np.shape[1:])
 
     cols = []
     for ci in range(first.column_count):
         ref = first._columns[ci]
+        if ci in vb_cols:
+            from ..data.strings import VarBytes
+
+            parts = [c.varbytes for c in lifted[ci]]
+            wc = word_caps[ci]
+            words = build([np.asarray(jax.device_get(
+                p.words[:p.total_words])) for p in parts], 0, pad_len=wc)
+            starts = build([np.asarray(jax.device_get(p.starts))
+                            for p in parts], 0)
+            lengths = build([np.asarray(jax.device_get(p.lengths))
+                             for p in parts], 0)
+            max_words = max(p.max_words for p in parts)
+            if ctx.get_process_count() > 1:
+                max_words = int(np.asarray(multihost_utils.process_allgather(
+                    np.array([max_words]))).max())
+            vb = VarBytes(words, starts, lengths, max_words, world * wc,
+                          shard_geom=(cap, wc))
+            validity = None
+            if any(t._columns[ci].validity is not None for t in tables):
+                validity = build(
+                    [jax.device_get(t._columns[ci].valid_mask())
+                     for t in tables], False)
+            cols.append(Column(vb.lengths, ref.dtype, validity, None,
+                               ref.name, varbytes=vb))
+            continue
         data = build([jax.device_get(t._columns[ci].data) for t in tables],
                      0)
         validity = None

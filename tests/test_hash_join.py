@@ -7,7 +7,12 @@ import numpy as np
 import pytest
 
 import cylon_tpu as ct
+
 from cylon_tpu.ops import join as _join
+
+# interpreter-heavy Pallas kernels: excluded from the quick tier
+pytestmark = pytest.mark.slow
+
 
 
 @pytest.fixture

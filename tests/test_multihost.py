@@ -17,6 +17,9 @@ import sys
 import numpy as np
 import pytest
 
+# multi-process (slow spawn + compile): excluded from the quick tier
+pytestmark = pytest.mark.slow
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _CHILD = r"""
@@ -50,6 +53,7 @@ lv = rng.integers(0, 1000, n_per * w).astype(np.int64)
 rk = rng.integers(0, 400, n_per * w).astype(np.int64)
 rv = rng.integers(0, 1000, n_per * w).astype(np.int64)
 import pandas as pd
+
 exp_join = pd.merge(pd.DataFrame({"k": lk, "v": lv}),
                     pd.DataFrame({"k": rk, "w": rv}), on="k")
 

@@ -9,6 +9,9 @@ import pytest
 import cylon_tpu as ct
 from cylon_tpu.ops import join as _join
 
+# interpreter-heavy / multi-process: excluded from the quick tier
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def ctx():

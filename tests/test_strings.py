@@ -1,0 +1,416 @@
+"""Device-native varlen strings (data/strings.py VarBytes) through the
+local op surface: ingest policy, join, groupby, set ops, sort, filter,
+export. Reference behavior being matched: string/binary columns flow
+through every kernel (join/join.cpp:648-799, arrow_kernels.hpp:101,
+arrow_partition_kernels.hpp:94) — here with no host-side vocabulary."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.data import strings as _strings
+from cylon_tpu.data.column import Column, as_varbytes
+from cylon_tpu.data.strings import VarBytes
+
+
+@pytest.fixture
+def ctx():
+    return ct.CylonContext.Init()
+
+
+def _rand_strings(rng, n, lo=1, hi=18, alpha=26):
+    lens = rng.integers(lo, hi, n)
+    chars = rng.integers(97, 97 + alpha, int(lens.sum())).astype(np.uint8)
+    offs = np.concatenate([[0], np.cumsum(lens)])
+    return np.array([chars[offs[i]:offs[i + 1]].tobytes().decode()
+                     for i in range(n)], dtype=object)
+
+
+def _force_varbytes(monkeypatch):
+    """Drop the dictionary threshold so every string ingest is varbytes."""
+    monkeypatch.setattr(_strings, "DICT_MAX_VOCAB", 0)
+
+
+def test_ingest_policy(ctx, monkeypatch):
+    # low cardinality → dictionary; high cardinality → varbytes
+    rng = np.random.default_rng(0)
+    low = ct.Table.from_pydict(ctx, {
+        "s": np.array(["a", "b", "a", "c"] * 50, dtype=object)})
+    assert low.get_column(0).dictionary is not None
+    hi_vals = _rand_strings(rng, 500, 8, 20)
+    monkeypatch.setattr(_strings, "DICT_MAX_VOCAB", 16)
+    hi = ct.Table.from_pydict(ctx, {"s": hi_vals})
+    assert hi.get_column(0).is_varbytes
+    assert list(hi.to_pydict()["s"]) == list(hi_vals)
+
+
+def test_varbytes_roundtrip_with_nulls(ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    vals = np.array(["alpha", None, "", "beta", None], dtype=object)
+    t = ct.Table.from_pandas(ctx, pd.DataFrame({"s": vals}))
+    assert t.get_column(0).is_varbytes
+    out = t.to_pydict()["s"]
+    assert list(out) == ["alpha", None, "", "beta", None]
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_join_on_varbytes_keys(ctx, monkeypatch, how):
+    _force_varbytes(monkeypatch)
+    rng = np.random.default_rng(1)
+    keys = _rand_strings(rng, 60, 1, 6, 4)  # heavy duplication
+    lk = keys[rng.integers(0, 60, 300)]
+    rk = keys[rng.integers(0, 60, 200)]
+    ldf = pd.DataFrame({"k": lk, "x": np.arange(300, dtype=np.int64)})
+    rdf = pd.DataFrame({"k": rk, "y": np.arange(200, dtype=np.int64)})
+    left = ct.Table.from_pandas(ctx, ldf)
+    right = ct.Table.from_pandas(ctx, rdf)
+    assert left.get_column(0).is_varbytes
+    got = left.join(right, how, "sort", on=["k"]).to_pandas()
+    exp = ldf.merge(rdf, how=how, on="k")
+    assert got.shape[0] == exp.shape[0]
+    g = got.sort_values(["lt-0", "lt-1", "rt-3"], na_position="last") \
+        .reset_index(drop=True)
+    # key column contents round-tripped: multiset of (k, x, y)
+    gset = sorted(map(tuple, got.fillna(-1).itertuples(index=False)))
+    # align column order: got is [lt-0(k), lt-1(x), rt-2(k), rt-3(y)]
+    eset = sorted((k if isinstance(k, str) else -1, x,
+                   k if isinstance(k, str) else -1, y)
+                  for k, x, y in exp.fillna(-1).itertuples(index=False))
+    # outer joins null one side's key; compare loosely on counts per key
+    if how == "inner":
+        assert gset == [(k, x, k2, y) for (k, x, k2, y) in gset]
+        assert sorted((r[0], r[1], r[3]) for r in gset) == \
+            sorted((k, x, y) for (k, x, _k2, y) in eset)
+    del g
+
+
+def test_join_varbytes_vs_dictionary_equivalence(ctx, monkeypatch):
+    """Same data, both storages, identical multiset results."""
+    rng = np.random.default_rng(2)
+    keys = _rand_strings(rng, 40, 2, 8)
+    lk = keys[rng.integers(0, 40, 250)]
+    rk = keys[rng.integers(0, 40, 150)]
+    ldf = pd.DataFrame({"k": lk, "x": np.arange(250)})
+    rdf = pd.DataFrame({"k": rk, "y": np.arange(150)})
+    l_dict = ct.Table.from_pandas(ctx, ldf)
+    r_dict = ct.Table.from_pandas(ctx, rdf)
+    assert not l_dict.get_column(0).is_varbytes
+    _force_varbytes(monkeypatch)
+    l_vb = ct.Table.from_pandas(ctx, ldf)
+    r_vb = ct.Table.from_pandas(ctx, rdf)
+    assert l_vb.get_column(0).is_varbytes
+    a = l_dict.join(r_dict, "inner", "sort", on=["k"]).to_pandas()
+    b = l_vb.join(r_vb, "inner", "sort", on=["k"]).to_pandas()
+    key = lambda df: sorted(map(tuple, df.itertuples(index=False)))
+    assert key(a) == key(b)
+    # mixed storages align too (dictionary side is lifted)
+    c = l_dict.join(r_vb, "inner", "sort", on=["k"]).to_pandas()
+    assert key(a) == key(c)
+
+
+def test_join_hash_algorithm_varbytes(ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    rng = np.random.default_rng(3)
+    keys = _rand_strings(rng, 30, 2, 10)
+    lk = keys[rng.integers(0, 30, 200)]
+    rk = keys[rng.integers(0, 30, 100)]
+    ldf = pd.DataFrame({"k": lk, "x": np.arange(200)})
+    rdf = pd.DataFrame({"k": rk, "y": np.arange(100)})
+    left = ct.Table.from_pandas(ctx, ldf)
+    right = ct.Table.from_pandas(ctx, rdf)
+    got = left.join(right, "inner", "hash", on=["k"]).to_pandas()
+    exp = ldf.merge(rdf, how="inner", on="k")
+    assert got.shape[0] == exp.shape[0]
+    assert sorted(zip(got["lt-0"], got["lt-1"], got["rt-3"])) == \
+        sorted(zip(exp["k"], exp["x"], exp["y"]))
+
+
+def test_groupby_varbytes_keys(ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    rng = np.random.default_rng(4)
+    keys = _rand_strings(rng, 25, 3, 9)
+    k = keys[rng.integers(0, 25, 400)]
+    v = rng.integers(0, 100, 400).astype(np.int64)
+    w = rng.integers(0, 100, 400).astype(np.int64)
+    df = pd.DataFrame({"k": k, "v": v, "w": w})
+    t = ct.Table.from_pandas(ctx, df)
+    assert t.get_column(0).is_varbytes
+    got = t.groupby(0, [1, 2], ["sum", "count"]).to_pandas()
+    exp = df.groupby("k").agg(sum=("v", "sum"),
+                              count=("w", "count")).reset_index()
+    got = got.sort_values(got.columns[0]).reset_index(drop=True)
+    exp = exp.sort_values("k").reset_index(drop=True)
+    assert list(got.iloc[:, 0]) == list(exp["k"])
+    assert list(got.iloc[:, 1]) == list(exp["sum"])
+    assert list(got.iloc[:, 2]) == list(exp["count"])
+
+
+def test_setops_varbytes(ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    rng = np.random.default_rng(5)
+    keys = _rand_strings(rng, 30, 2, 7)
+    a = pd.DataFrame({"s": keys[rng.integers(0, 30, 120)],
+                      "i": rng.integers(0, 3, 120).astype(np.int64)})
+    b = pd.DataFrame({"s": keys[rng.integers(0, 30, 90)],
+                      "i": rng.integers(0, 3, 90).astype(np.int64)})
+    ta = ct.Table.from_pandas(ctx, a)
+    tb = ct.Table.from_pandas(ctx, b)
+    for name, fn in (("union", lambda x, y: pd.concat([x, y])),
+                     ("subtract", None), ("intersect", None)):
+        got = getattr(ta, name)(tb).to_pandas()
+        arows = set(map(tuple, a.itertuples(index=False)))
+        brows = set(map(tuple, b.itertuples(index=False)))
+        if name == "union":
+            exp = arows | brows
+        elif name == "subtract":
+            exp = arows - brows
+        else:
+            exp = arows & brows
+        assert set(map(tuple, got.itertuples(index=False))) == exp
+        assert got.shape[0] == len(exp)
+
+
+def test_sort_varbytes(ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    rng = np.random.default_rng(6)
+    vals = _rand_strings(rng, 300, 0 + 1, 14, 5)
+    t = ct.Table.from_pydict(ctx, {"s": vals,
+                                   "i": np.arange(300, dtype=np.int64)})
+    got = t.sort("s").to_pydict()["s"]
+    assert list(got) == sorted(vals)
+    got_d = t.sort("s", ascending=False).to_pydict()["s"]
+    assert list(got_d) == sorted(vals, reverse=True)
+
+
+def test_sort_varbytes_long_rows_host_fallback(ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    rng = np.random.default_rng(7)
+    vals = np.array([("x" * int(n)) + s for n, s in
+                     zip(rng.integers(60, 90, 50),
+                         _rand_strings(rng, 50, 1, 5))], dtype=object)
+    t = ct.Table.from_pydict(ctx, {"s": vals})
+    assert not t.get_column(0).varbytes.sortable_on_device
+    assert list(t.sort("s").to_pydict()["s"]) == sorted(vals)
+
+
+def test_filter_and_literal_compare(ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    vals = np.array(["apple", "pear", "apple", "fig", "pear", "apple"],
+                    dtype=object)
+    t = ct.Table.from_pydict(ctx, {"s": vals,
+                                   "i": np.arange(6, dtype=np.int64)})
+    f = t[t["s"] == "apple"]
+    assert list(f.to_pydict()["i"]) == [0, 2, 5]
+    f2 = t[t["s"] != "apple"]
+    assert list(f2.to_pydict()["i"]) == [1, 3, 4]
+
+
+def test_scalar_min_max_varbytes(ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    vals = np.array(["mango", "apple", "zebra", "kiwi"], dtype=object)
+    t = ct.Table.from_pydict(ctx, {"s": vals})
+    assert t.min("s").to_pydict()["s"][0] == "apple"
+    assert t.max("s").to_pydict()["s"][0] == "zebra"
+
+
+def test_concat_mixed_storage(ctx, monkeypatch):
+    low = ct.Table.from_pydict(ctx, {
+        "s": np.array(["a", "b", "a"] * 20, dtype=object)})
+    _force_varbytes(monkeypatch)
+    hi = ct.Table.from_pydict(ctx, {
+        "s": _rand_strings(np.random.default_rng(8), 40, 5, 12)})
+    m = low.merge(hi)
+    assert m.row_count == 100
+    assert m.get_column(0).is_varbytes
+    exp = list(low.to_pydict()["s"]) + list(hi.to_pydict()["s"])
+    assert list(m.to_pydict()["s"]) == exp
+
+
+def test_csv_roundtrip_varbytes(ctx, monkeypatch, tmp_path):
+    _force_varbytes(monkeypatch)
+    rng = np.random.default_rng(9)
+    df = pd.DataFrame({"s": _rand_strings(rng, 80, 3, 10),
+                       "v": rng.integers(0, 50, 80).astype(np.int64)})
+    t = ct.Table.from_pandas(ctx, df)
+    p = tmp_path / "s.csv"
+    t.to_csv(str(p))
+    back = pd.read_csv(p)
+    pd.testing.assert_frame_equal(back, df, check_dtype=False)
+
+
+def test_nulls_join_never_match(ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    ldf = pd.DataFrame({"k": np.array(["a", None, "b", None], dtype=object),
+                        "x": np.arange(4)})
+    rdf = pd.DataFrame({"k": np.array([None, "a", "c"], dtype=object),
+                        "y": np.arange(3)})
+    left = ct.Table.from_pandas(ctx, ldf)
+    right = ct.Table.from_pandas(ctx, rdf)
+    got = left.join(right, "inner", "sort", on=["k"]).to_pandas()
+    assert got.shape[0] == 1
+    assert got.iloc[0]["lt-0"] == "a" and got.iloc[0]["rt-2"] == "a"
+
+
+def test_groupby_nulls_group_together(ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    df = pd.DataFrame({"k": np.array(["a", None, "a", None, "b"],
+                                     dtype=object),
+                       "v": np.array([1, 2, 3, 4, 5], dtype=np.int64)})
+    t = ct.Table.from_pandas(ctx, df)
+    got = t.groupby(0, [1], ["sum"]).to_pandas()
+    by_key = {k if isinstance(k, str) else None: v
+              for k, v in zip(got.iloc[:, 0], got.iloc[:, 1])}
+    assert by_key["a"] == 4 and by_key["b"] == 5 and by_key[None] == 6
+
+
+# ---------------------------------------------------------------------------
+# distributed: varbytes through shuffle / join / groupby / set ops on the
+# virtual 8-device mesh (reference composition: DistributedJoin
+# table.cpp:656-696 with BinaryHashPartitionKernel string placement)
+# ---------------------------------------------------------------------------
+
+
+def test_dist_join_varbytes(dist_ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    rng = np.random.default_rng(11)
+    keys = _rand_strings(rng, 50, 2, 10)
+    lk = keys[rng.integers(0, 50, 400)]
+    rk = keys[rng.integers(0, 50, 300)]
+    ldf = pd.DataFrame({"k": lk, "x": np.arange(400, dtype=np.int64)})
+    rdf = pd.DataFrame({"k": rk, "y": np.arange(300, dtype=np.int64)})
+    left = ct.Table.from_pandas(dist_ctx, ldf)
+    right = ct.Table.from_pandas(dist_ctx, rdf)
+    assert left.get_column(0).is_varbytes
+    got = left.distributed_join(right, "inner", "sort", on=["k"]).to_pandas()
+    exp = ldf.merge(rdf, how="inner", on="k")
+    assert got.shape[0] == exp.shape[0]
+    assert sorted(zip(got["lt-0"], got["lt-1"], got["rt-3"])) == \
+        sorted(zip(exp["k"], exp["x"], exp["y"]))
+    # key columns round-tripped exactly on both sides
+    assert (got["lt-0"] == got["rt-2"]).all()
+
+
+def test_dist_join_mixed_storage(dist_ctx, monkeypatch):
+    rng = np.random.default_rng(12)
+    keys = _rand_strings(rng, 30, 2, 8)
+    ldf = pd.DataFrame({"k": keys[rng.integers(0, 30, 200)],
+                        "x": np.arange(200, dtype=np.int64)})
+    rdf = pd.DataFrame({"k": keys[rng.integers(0, 30, 150)],
+                        "y": np.arange(150, dtype=np.int64)})
+    left = ct.Table.from_pandas(dist_ctx, ldf)   # dictionary
+    assert not left.get_column(0).is_varbytes
+    _force_varbytes(monkeypatch)
+    right = ct.Table.from_pandas(dist_ctx, rdf)  # varbytes
+    assert right.get_column(0).is_varbytes
+    got = left.distributed_join(right, "inner", "sort", on=["k"]).to_pandas()
+    exp = ldf.merge(rdf, how="inner", on="k")
+    assert got.shape[0] == exp.shape[0]
+    assert sorted(zip(got["lt-0"], got["lt-1"], got["rt-3"])) == \
+        sorted(zip(exp["k"], exp["x"], exp["y"]))
+
+
+def test_dist_groupby_varbytes(dist_ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    rng = np.random.default_rng(13)
+    keys = _rand_strings(rng, 40, 3, 9)
+    k = keys[rng.integers(0, 40, 500)]
+    v = rng.integers(0, 100, 500).astype(np.int64)
+    df = pd.DataFrame({"k": k, "v": v})
+    t = ct.Table.from_pandas(dist_ctx, df)
+    got = t.groupby(0, [1], ["sum"]).to_pandas()
+    exp = df.groupby("k")["v"].sum().reset_index()
+    got = got.sort_values(got.columns[0]).reset_index(drop=True)
+    exp = exp.sort_values("k").reset_index(drop=True)
+    assert list(got.iloc[:, 0]) == list(exp["k"])
+    assert list(got.iloc[:, 1]) == list(exp["v"])
+
+
+def test_dist_setops_varbytes(dist_ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    rng = np.random.default_rng(14)
+    keys = _rand_strings(rng, 25, 2, 7)
+    a = pd.DataFrame({"s": keys[rng.integers(0, 25, 160)],
+                      "i": rng.integers(0, 3, 160).astype(np.int64)})
+    b = pd.DataFrame({"s": keys[rng.integers(0, 25, 120)],
+                      "i": rng.integers(0, 3, 120).astype(np.int64)})
+    ta = ct.Table.from_pandas(dist_ctx, a)
+    tb = ct.Table.from_pandas(dist_ctx, b)
+    arows = set(map(tuple, a.itertuples(index=False)))
+    brows = set(map(tuple, b.itertuples(index=False)))
+    for name, exp in (("distributed_union", arows | brows),
+                      ("distributed_subtract", arows - brows),
+                      ("distributed_intersect", arows & brows)):
+        got = getattr(ta, name)(tb).to_pandas()
+        assert set(map(tuple, got.itertuples(index=False))) == exp
+        assert got.shape[0] == len(exp)
+
+
+def test_dist_shuffle_varbytes_preserves_rows(dist_ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    from cylon_tpu.parallel import dist_ops
+
+    rng = np.random.default_rng(15)
+    vals = _rand_strings(rng, 300, 1, 15)
+    df = pd.DataFrame({"s": vals, "i": np.arange(300, dtype=np.int64)})
+    t = ct.Table.from_pandas(dist_ctx, df)
+    sh = dist_ops.shuffle(t, ["s"])
+    got = sh.to_pandas().sort_values("i").reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got, df.sort_values("i").reset_index(drop=True), check_dtype=False)
+
+
+def test_multihost_ingest_strings(dist_ctx, monkeypatch):
+    """assemble_process_local now accepts string columns (varbytes — no
+    global vocabulary needed)."""
+    from cylon_tpu.parallel import shard as _shard
+
+    _force_varbytes(monkeypatch)
+    rng = np.random.default_rng(16)
+    world = dist_ctx.get_world_size()
+    per = []
+    all_rows = []
+    for s in range(world):
+        n = 20 + s * 3
+        vals = _rand_strings(rng, n, 1, 12)
+        iv = rng.integers(0, 100, n).astype(np.int64)
+        per.append(ct.Table.from_pydict(dist_ctx, {"s": vals, "i": iv}))
+        all_rows += list(zip(vals, iv))
+    local = ct.CylonContext.Init()
+    # single-controller: this process owns every shard
+    t = _shard.assemble_process_local(per, dist_ctx)
+    got = t.to_pandas()
+    assert sorted(map(tuple, got.itertuples(index=False))) == \
+        sorted(all_rows)
+    del local
+
+
+def test_empty_take_and_slice(ctx, monkeypatch):
+    _force_varbytes(monkeypatch)
+    t = ct.Table.from_pydict(ctx, {
+        "s": _rand_strings(np.random.default_rng(20), 40, 2, 8),
+        "i": np.arange(40, dtype=np.int64)})
+    # empty slice
+    e = t.slice(3, 3)
+    assert e.row_count == 0
+    # over-long slice clamps like fixed-width columns
+    s = t.slice(2, 1000)
+    assert s.row_count == 38
+    c = s.get_column(0)
+    assert c.data.shape[0] == 38
+    assert c.varbytes.lengths.shape[0] == 38
+    # single row
+    one = t[5]
+    assert one.row_count == 1
+
+
+def test_binary_roundtrip(ctx):
+    import pyarrow as pa
+
+    vals = [b"\xff\x00\x01", b"plain", b"", b"\x80\x81" * 9, None]
+    # binary always takes the varbytes path (no sorted-str vocab)
+    arr = pa.table({"b": pa.array(vals, type=pa.binary())})
+    t = ct.Table.from_arrow(ctx, arr)
+    c = t.get_column(0)
+    assert c.is_varbytes
+    back = t.to_arrow()["b"].to_pylist()
+    assert back == vals
