@@ -2,17 +2,25 @@
 chip(s).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
-The primary metric is the distributed inner-join throughput; the rest of
-the tracked matrix (groupby-aggregate, global sort, set ops, TPC-H-Q5-style
-multi-join pipeline — BASELINE.md "Tracked configs") rides in
-detail.suite.
+The primary metric is the DISTRIBUTED inner-join throughput — the honest
+shuffle+join composition the baseline measures: even on one chip the
+exchange executes on a 1-wide mesh (``force_exchange``), so the count
+phase, blockwise all_to_all rounds and compaction are all in the timed
+path. The local join is reported separately (detail.local_inner_join),
+as is the raw shuffle bandwidth (detail.shuffle_gbps — a BASELINE.md
+tracked metric). The rest of the matrix (groupby-aggregate, global sort,
+set ops, TPC-H-Q5-style pipeline) rides in detail.suite.
 
-Baseline: the reference's published single-worker distributed inner join —
-200M rows in 141.5 s ≈ 1.414M rows/s/worker (reference:
+Timing discipline: ``jax.block_until_ready`` is a NO-OP on the axon
+platform, so every timed closure ends with a one-element
+``jax.device_get`` of its output — real execution, not dispatch, is on
+the clock.
+
+Baseline: the reference's published single-worker distributed inner join
+— 200M rows in 141.5 s ≈ 1.414M rows/s/worker (reference:
 docs/docs/arch.md:152, arXiv:2007.09589; see BASELINE.md). vs_baseline is
 our rows/sec/chip over that per-worker rate. The other configs have no
-published reference numbers (BASELINE.md:26-28) — their vs_baseline is
-null.
+published reference numbers (BASELINE.md:26-28).
 """
 from __future__ import annotations
 
@@ -25,9 +33,17 @@ import numpy as np
 _BASELINE_ROWS_PER_S = 200e6 / 141.5
 
 
-def _time(fn, iters):
+def _sync(t):
+    """Force execution (block_until_ready is a no-op on axon): fetch one
+    element of the output column and the row mask."""
     import jax
 
+    jax.device_get(t.get_column(0).data[:1])
+    if t.row_mask is not None:
+        jax.device_get(t.row_mask[:1])
+
+
+def _time(fn, iters):
     fn()  # warmup/compile
     times = []
     for _ in range(iters):
@@ -38,18 +54,14 @@ def _time(fn, iters):
 
 
 def _mk_ctx():
-    import jax
-
     import cylon_tpu as ct
 
-    if len(jax.devices()) > 1:
-        return ct.CylonContext.InitDistributed(ct.TPUConfig())
-    return ct.CylonContext.Init()
+    # a distributed context even at world 1: the bench times the real
+    # exchange path on whatever mesh is attached
+    return ct.CylonContext.InitDistributed(ct.TPUConfig())
 
 
-def bench_join(ctx, n_rows: int, iters: int) -> dict:
-    import jax
-
+def _join_tables(ctx, n_rows):
     import cylon_tpu as ct
 
     rng = np.random.default_rng(0)
@@ -61,30 +73,91 @@ def bench_join(ctx, n_rows: int, iters: int) -> dict:
         "k": rng.integers(0, n_rows, n_rows).astype(np.int32),
         "w": rng.normal(size=n_rows).astype(np.float32),
     })
+    return left, right
 
+
+def bench_local_join(ctx, n_rows: int, iters: int) -> dict:
+    """Per-chip local join (no shuffle) — the kernel-only number."""
+    left, right = _join_tables(ctx, n_rows)
     out = {}
 
-    def one_join():
-        if ctx.is_distributed():
-            t = left.distributed_join(right, "inner", on="k")
-        else:
-            t = left.join(right, "inner", on="k")
-        jax.block_until_ready(t.get_column(0).data)
+    def one():
+        t = left.join(right, "inner", on="k")
+        _sync(t)
         out["t"] = t
 
-    best = _time(one_join, iters)
-    total_rows = 2 * n_rows  # rows ingested by the join (both sides)
-    world = max(ctx.get_world_size(), 1)
+    best = _time(one, iters)
+    total_rows = 2 * n_rows
     return {
-        "rows_per_s_per_chip": total_rows / best / world,
+        "rows_per_s_per_chip": total_rows / best,
         "wall_s_best": round(best, 4),
         "out_rows": out["t"].row_count,
     }
 
 
-def bench_groupby(ctx, n_rows: int, iters: int) -> dict:
-    import jax
+def bench_dist_join(ctx, n_rows: int, iters: int) -> dict:
+    """The honest distributed composition: hash-partition + count
+    exchange + blockwise all_to_all + per-shard join — forced even on a
+    1-wide mesh so the collective machinery is always on the clock."""
+    from cylon_tpu.ops.join import JoinConfig
+    from cylon_tpu.parallel import dist_ops
 
+    left, right = _join_tables(ctx, n_rows)
+    cfg = JoinConfig.InnerJoin([0], [0])
+    out = {}
+
+    def one():
+        t = dist_ops.distributed_join(left, right, cfg,
+                                      force_exchange=True)
+        _sync(t)
+        out["t"] = t
+
+    best = _time(one, iters)
+    world = max(ctx.get_world_size(), 1)
+    return {
+        "rows_per_s_per_chip": 2 * n_rows / best / world,
+        "wall_s_best": round(best, 4),
+        "out_rows": out["t"].row_count,
+    }
+
+
+def bench_shuffle(ctx, n_rows: int, iters: int) -> dict:
+    """Raw shuffle bandwidth (BASELINE.md tracked metric): bytes of
+    payload delivered through the two-phase count+blockwise exchange per
+    second per chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from cylon_tpu.parallel import shard as _shard
+    from cylon_tpu.parallel.shuffle import exchange
+
+    rng = np.random.default_rng(7)
+    world = max(ctx.get_world_size(), 1)
+    payload = {
+        "a": _shard.pin(jnp.asarray(
+            rng.integers(0, 1 << 31, n_rows).astype(np.int32)), ctx),
+        "b": _shard.pin(jnp.asarray(
+            rng.normal(size=n_rows).astype(np.float32)), ctx),
+        "c": _shard.pin(jnp.asarray(
+            rng.integers(0, 1 << 31, n_rows).astype(np.int64)), ctx),
+    }
+    targets = _shard.pin(jnp.asarray(
+        rng.integers(0, world, n_rows).astype(np.int32)), ctx)
+    emit = _shard.pin(jnp.ones(n_rows, dtype=bool), ctx)
+    bytes_per_row = 4 + 4 + 8
+
+    def one():
+        out, new_emit, _cap, _meta = exchange(payload, targets, emit, ctx)
+        jax.device_get(out["a"][:1])
+
+    best = _time(one, iters)
+    gbps = n_rows * bytes_per_row / best / 1e9 / world
+    return {"gbps_per_chip": round(gbps, 3),
+            "rows_per_s_per_chip": n_rows / best / world,
+            "wall_s_best": round(best, 4)}
+
+
+def bench_groupby(ctx, n_rows: int, iters: int) -> dict:
     import cylon_tpu as ct
 
     rng = np.random.default_rng(1)
@@ -96,7 +169,7 @@ def bench_groupby(ctx, n_rows: int, iters: int) -> dict:
 
     def one():
         g = t.groupby(0, [1, 2, 1], ["sum", "count", "mean"])
-        jax.block_until_ready(g.get_column(0).data)
+        _sync(g)
 
     best = _time(one, iters)
     world = max(ctx.get_world_size(), 1)
@@ -105,8 +178,6 @@ def bench_groupby(ctx, n_rows: int, iters: int) -> dict:
 
 
 def bench_sort(ctx, n_rows: int, iters: int) -> dict:
-    import jax
-
     import cylon_tpu as ct
 
     rng = np.random.default_rng(2)
@@ -114,11 +185,11 @@ def bench_sort(ctx, n_rows: int, iters: int) -> dict:
         "k": rng.integers(0, 1 << 31, n_rows).astype(np.int32),
         "v": rng.normal(size=n_rows).astype(np.float32),
     })
+    dist = ctx.is_distributed() and ctx.get_world_size() > 1
 
     def one():
-        s = ct.distributed_sort(t, "k") if ctx.is_distributed() \
-            else t.sort("k")
-        jax.block_until_ready(s.get_column(0).data)
+        s = ct.distributed_sort(t, "k") if dist else t.sort("k")
+        _sync(s)
 
     best = _time(one, iters)
     world = max(ctx.get_world_size(), 1)
@@ -127,8 +198,6 @@ def bench_sort(ctx, n_rows: int, iters: int) -> dict:
 
 
 def bench_setops(ctx, n_rows: int, iters: int) -> dict:
-    import jax
-
     import cylon_tpu as ct
 
     rng = np.random.default_rng(3)
@@ -140,10 +209,11 @@ def bench_setops(ctx, n_rows: int, iters: int) -> dict:
         "k": rng.integers(0, n_rows, n_rows).astype(np.int32),
         "g": rng.integers(0, 1 << 20, n_rows).astype(np.int32),
     })
+    dist = ctx.is_distributed() and ctx.get_world_size() > 1
 
     def one():
-        u = a.distributed_union(b) if ctx.is_distributed() else a.union(b)
-        jax.block_until_ready(u.get_column(0).data)
+        u = a.distributed_union(b) if dist else a.union(b)
+        _sync(u)
 
     best = _time(one, iters)
     world = max(ctx.get_world_size(), 1)
@@ -151,11 +221,86 @@ def bench_setops(ctx, n_rows: int, iters: int) -> dict:
             "wall_s_best": round(best, 4)}
 
 
+def bench_string_join(ctx, n_rows: int, iters: int) -> dict:
+    """Varbytes string-key join: device content-hash identity, no host
+    vocabulary (the high-cardinality ETL case)."""
+    import cylon_tpu as ct
+    from cylon_tpu.data.strings import VarBytes
+    from cylon_tpu.data.column import Column
+    from cylon_tpu.data.table import Table
+
+    rng = np.random.default_rng(5)
+    n_keys = max(n_rows // 4, 1)
+
+    def make(n, seed):
+        r = np.random.default_rng(seed)
+        ks = r.integers(0, n_keys, n)
+        # synthesize key strings without a python loop: "u" + 8 hex chars
+        hexd = np.frombuffer(b"0123456789abcdef", np.uint8)
+        b = np.empty((n, 12), np.uint8)
+        b[:, 0] = ord("u")
+        for j in range(8):
+            b[:, 1 + j] = hexd[(ks >> (28 - 4 * j)) & 0xF]
+        b[:, 9:] = ord("x")
+        lengths = np.full(n, 12, np.int32)
+        vb = VarBytes._from_packed(b.tobytes(), lengths)
+        cols = [Column.from_varbytes(vb, None, "k"),
+                Column.from_numpy(r.normal(size=n).astype(np.float32), "v")]
+        return Table(cols, ctx)
+
+    left = make(n_rows, 10)
+    right = make(n_rows, 11)
+
+    def one():
+        t = left.join(right, "inner", on="k")
+        _sync(t)
+
+    best = _time(one, iters)
+    return {"rows_per_s_per_chip": 2 * n_rows / best,
+            "wall_s_best": round(best, 4)}
+
+
+def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
+    import jax
+
+    ctx = _mk_ctx()
+    dist_res = bench_dist_join(ctx, n_rows, iters)
+    local_res = bench_local_join(ctx, n_rows, iters)
+    shuffle_res = bench_shuffle(ctx, n_rows, iters)
+    suite = {}
+    if full:
+        suite["groupby_agg"] = bench_groupby(ctx, n_rows, iters)
+        suite["global_sort"] = bench_sort(ctx, n_rows, iters)
+        suite["set_union"] = bench_setops(ctx, n_rows // 2, iters)
+        suite["q5_pipeline"] = bench_q5_pipeline(ctx, n_rows // 2, iters)
+        suite["string_join"] = bench_string_join(ctx, n_rows // 4, iters)
+    rps = dist_res["rows_per_s_per_chip"]
+    return {
+        "metric": "dist_inner_join_rows_per_sec_per_chip",
+        "value": round(rps, 1),
+        "unit": "rows/s/chip",
+        "vs_baseline": round(rps / _BASELINE_ROWS_PER_S, 3),
+        "detail": {
+            "n_rows_per_side": n_rows,
+            "world": ctx.get_world_size(),
+            "wall_s_best": dist_res["wall_s_best"],
+            "out_rows": dist_res["out_rows"],
+            "backend": jax.devices()[0].platform,
+            "local_inner_join": {
+                k: (round(v, 1) if isinstance(v, float) else v)
+                for k, v in local_res.items()},
+            "shuffle_gbps": shuffle_res["gbps_per_chip"],
+            "shuffle": shuffle_res,
+            "suite": {k: {kk: (round(vv, 4) if isinstance(vv, float) else vv)
+                          for kk, vv in v.items()}
+                      for k, v in suite.items()},
+        },
+    }
+
+
 def bench_q5_pipeline(ctx, n_rows: int, iters: int) -> dict:
     """TPC-H Q5 shape: 3-table star join + filter + grouped aggregate
     (customer ⋈ orders ⋈ lineitem-ish, then revenue by group)."""
-    import jax
-
     import cylon_tpu as ct
 
     rng = np.random.default_rng(4)
@@ -173,7 +318,7 @@ def bench_q5_pipeline(ctx, n_rows: int, iters: int) -> dict:
         "price": rng.exponential(100.0, n_rows).astype(np.float32),
     })
 
-    dist = ctx.is_distributed()
+    dist = ctx.is_distributed() and ctx.get_world_size() > 1
 
     def one():
         co = cust.distributed_join(orders, "inner", left_on=["ck"],
@@ -186,7 +331,7 @@ def bench_q5_pipeline(ctx, n_rows: int, iters: int) -> dict:
             full.join(items, "inner", left_on=[2], right_on=[0])
         # group revenue by region (col 1), summing price (last col)
         g = coi.groupby(1, [coi.column_count - 1], ["sum"])
-        jax.block_until_ready(g.get_column(0).data)
+        _sync(g)
 
     best = _time(one, iters)
     world = max(ctx.get_world_size(), 1)
@@ -194,36 +339,6 @@ def bench_q5_pipeline(ctx, n_rows: int, iters: int) -> dict:
     total = n_cust + n_rows // 4 + n_rows
     return {"rows_per_s_per_chip": total / best / world,
             "wall_s_best": round(best, 4)}
-
-
-def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
-    import jax
-
-    ctx = _mk_ctx()
-    join_res = bench_join(ctx, n_rows, iters)
-    suite = {}
-    if full:
-        suite["groupby_agg"] = bench_groupby(ctx, n_rows, iters)
-        suite["global_sort"] = bench_sort(ctx, n_rows, iters)
-        suite["set_union"] = bench_setops(ctx, n_rows // 2, iters)
-        suite["q5_pipeline"] = bench_q5_pipeline(ctx, n_rows // 2, iters)
-    rps = join_res["rows_per_s_per_chip"]
-    return {
-        "metric": "dist_inner_join_rows_per_sec_per_chip",
-        "value": round(rps, 1),
-        "unit": "rows/s/chip",
-        "vs_baseline": round(rps / _BASELINE_ROWS_PER_S, 3),
-        "detail": {
-            "n_rows_per_side": n_rows,
-            "world": ctx.get_world_size(),
-            "wall_s_best": join_res["wall_s_best"],
-            "out_rows": join_res["out_rows"],
-            "backend": jax.devices()[0].platform,
-            "suite": {k: {kk: (round(vv, 1) if isinstance(vv, float) else vv)
-                          for kk, vv in v.items()}
-                      for k, v in suite.items()},
-        },
-    }
 
 
 if __name__ == "__main__":

@@ -181,9 +181,29 @@ class Table:
         cols = [c.take(jnp.asarray(idx)) for c in self._columns]
         return Table(cols, self._ctx)
 
+    def _unique_names(self) -> List[str]:
+        """Column names with duplicates suffixed (_2, _3, …) so dict
+        exports can't silently drop columns (groupby emits one output
+        per (column, op) pair — names repeat)."""
+        seen: Dict[str, int] = {}
+        used = set()
+        out = []
+        for c in self._columns:
+            k = seen.get(c.name, 0) + 1
+            name = c.name if k == 1 else f"{c.name}_{k}"
+            # suffixes can still collide with literal column names
+            while name in used:
+                k += 1
+                name = f"{c.name}_{k}"
+            seen[c.name] = k
+            used.add(name)
+            out.append(name)
+        return out
+
     def to_pydict(self) -> Dict[str, np.ndarray]:
         t = self.compact()
-        return {c.name: c.to_numpy() for c in t._columns}
+        return {n: c.to_numpy()
+                for n, c in zip(t._unique_names(), t._columns)}
 
     def to_numpy(self, order: str = "F") -> np.ndarray:
         t = self.compact()
@@ -195,13 +215,19 @@ class Table:
         import pandas as pd
 
         t = self.compact()
-        return pd.DataFrame({c.name: c.to_numpy() for c in t._columns})
+        # build positionally then rename: a dict would silently collapse
+        # duplicate column names (groupby outputs repeat source names)
+        df = pd.DataFrame({i: pd.Series(c.to_numpy())
+                           for i, c in enumerate(t._columns)})
+        df.columns = [c.name for c in t._columns]
+        return df
 
     def to_arrow(self):
         import pyarrow as pa
 
         t = self.compact()
-        return pa.table({c.name: c.to_pyarrow() for c in t._columns})
+        return pa.table([c.to_pyarrow() for c in t._columns],
+                        names=[c.name for c in t._columns])
 
     def to_csv(self, path: str, options: Optional[CSVWriteOptions] = None) -> None:
         from ..io.csv import write_csv
@@ -929,6 +955,12 @@ def groupby_local(table: Table, index_col, aggregate_cols: List,
                 "varbytes value columns support COUNT only (MIN/MAX need "
                 "a total order the content-hash identity does not carry; "
                 "dictionary-encode the column for string MIN/MAX)")
+    # streaming Pallas path (opt-in: measured slower than the XLA
+    # segment path on v5e — see ops/groupby.py block comment)
+    out = _groupby.stream_groupby_table(table, idx_cols, val_cols, ops)
+    if out is not None:
+        return out
+
     key_columns = [table._columns[i] for i in idx_cols]
     keys = []
     for c in key_columns:

@@ -88,6 +88,23 @@ def hash_columns(cols: Sequence[Column]) -> jnp.ndarray:
     return fmix32(h)
 
 
+def hash2_streams(lanes: Sequence[jnp.ndarray], live) -> "tuple":
+    """The 2x32-bit row-hash pair shared by every hash-sorted stream
+    path (hash join, wide-key groupby): combine u32 lanes with the
+    31/33 schemes over independent avalanches, then force dead rows to
+    all-ones so they sort to the tail."""
+    n = lanes[0].shape[0]
+    h1 = jnp.zeros(n, jnp.uint32)
+    h2 = jnp.full(n, jnp.uint32(0x9E3779B9))
+    for kl in lanes:
+        h1 = h1 * np.uint32(31) + fmix32(kl)
+        h2 = h2 * np.uint32(33) + fmix32b(kl)
+    allones = jnp.uint32(0xFFFFFFFF)
+    h1 = jnp.where(live, fmix32(h1), allones)
+    h2 = jnp.where(live, fmix32b(h2), allones)
+    return h1, h2
+
+
 def partition_targets(cols: Sequence[Column], world_size: int) -> jnp.ndarray:
     """Per-row target partition in [0, world_size) — the reference's
     `HashPartitionArray` modulo placement (arrow_partition_kernels.cpp:61-72)."""

@@ -570,7 +570,7 @@ def _plan_program_stream_impl(lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
     reports within-run mismatches (64-bit hash collisions) for the
     caller's exact fallback."""
     from . import tpu_kernels as tk
-    from .hash import fmix32, fmix32b
+    from .hash import hash2_streams
 
     lbits, lkv, rbits, rkv = _keys_to_bits(lkeys, lkvalid, rkeys, rkvalid,
                                            str_flags)
@@ -620,13 +620,7 @@ def _plan_program_stream_impl(lkeys, lkvalid, lemit, rkeys, rkvalid, remit,
                 kb_lanes.append(cat.astype(jnp.uint32))
             else:
                 kb_lanes.append(cat.astype(jnp.uint32))
-        h1 = jnp.zeros(n, jnp.uint32)
-        h2 = jnp.full(n, jnp.uint32(0x9E3779B9))
-        for kb in kb_lanes:
-            h1 = h1 * jnp.uint32(31) + fmix32(kb)
-            h2 = h2 * jnp.uint32(33) + fmix32b(kb)
-        h1 = jnp.where(live, fmix32(h1), allones)
-        h2 = jnp.where(live, fmix32b(h2), allones)
+        h1, h2 = hash2_streams(kb_lanes, live)
         res = jax.lax.sort((h1, h2, tag) + tuple(kb_lanes) + tuple(lanes),
                            num_keys=3)
         nk = len(kb_lanes)

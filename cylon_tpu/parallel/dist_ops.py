@@ -172,32 +172,56 @@ def _word_targets_fn(mesh):
 
 
 @lru_cache(maxsize=None)
-def _starts_fn(mesh):
-    """Rebuild per-shard packed starts from exchanged lengths."""
-    spec = P(mesh.axis_names[0])
+def _starts_reconcile_fn(mesh, row_block: int, word_block: int):
+    """Rebuild shard-relative varbytes starts after a row+word exchange
+    pair, for ANY combination of padded/compact layouts (block=0 means
+    compact). Both exchanges keep each source's items contiguous and in
+    matching order, so row (source s, j)'s words sit at that source's
+    word-segment offset plus the within-source word prefix."""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
 
-    def kernel(lengths):
+    def kernel(lengths, row_ci, word_ci):
+        n = lengths.shape[0]
         nw = (lengths + 3) >> 2
-        return jnp.cumsum(nw) - nw
+        cs = jnp.cumsum(nw)
+        if row_block:
+            row_off = jnp.arange(world, dtype=jnp.int32) * row_block
+        else:
+            row_off = jnp.cumsum(row_ci) - row_ci
+        if word_block:
+            word_off = jnp.arange(world, dtype=jnp.int32) * word_block
+        else:
+            word_off = jnp.cumsum(word_ci) - word_ci
+        pos = jnp.arange(n, dtype=jnp.int32)
+        sid = jnp.zeros(n, jnp.int32)
+        for s in range(1, world):
+            sid = sid + (pos >= row_off[s]).astype(jnp.int32)
+        head = jnp.where(row_off > 0,
+                         jnp.take(cs, jnp.maximum(row_off - 1, 0)), 0)
+        return jnp.take(word_off, sid) + (cs - nw) - jnp.take(head, sid)
 
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,),
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 3,
                              out_specs=spec))
 
 
 def _exchange_varbytes_words(ctx: CylonContext, vb, targets, emit,
-                             new_lengths):
+                             new_lengths, row_meta: dict):
     """The word-leg of a varbytes shuffle: words ride their own exchange
     (stability of the bucket sort keeps word order == row order), then
-    shard-relative starts rebuild from the exchanged lengths."""
+    shard-relative starts reconcile the two layouts."""
     from ..data.strings import VarBytes
 
     world = ctx.get_world_size()
     wt, wemit = _word_targets_fn(ctx.mesh)(
         shard.pin(vb.words, ctx), shard.pin(vb.starts, ctx),
         shard.pin(vb.lengths, ctx), targets, emit)
-    wout, _wemit2, _wcap = exchange({"w": shard.pin(vb.words, ctx)},
-                                    wt, wemit, ctx)
-    new_starts = _starts_fn(ctx.mesh)(new_lengths)
+    wout, _wemit2, _wcap, wmeta = exchange(
+        {"w": shard.pin(vb.words, ctx)}, wt, wemit, ctx)
+    new_starts = _starts_reconcile_fn(
+        ctx.mesh, row_meta["block"], wmeta["block"])(
+        new_lengths, row_meta["counts_in"], wmeta["counts_in"])
     return VarBytes(wout["w"], new_starts, new_lengths, vb.max_words,
                     int(wout["w"].shape[0]),
                     shard_geom=(int(new_lengths.shape[0]) // world,
@@ -214,12 +238,13 @@ def _exchange_table(t: Table, targets, emit, ctx: CylonContext,
         payload[f"d{i}"] = c.data  # byte lengths for varbytes columns
         payload[f"v{i}"] = c.valid_mask()
     payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
-    out, new_emit, _cap = exchange(payload, targets, emit, ctx)
+    out, new_emit, _cap, meta = exchange(payload, targets, emit, ctx)
     cols = []
     for i, c in enumerate(t._columns):
         d, v = out[f"d{i}"], out[f"v{i}"]
         if c.is_varbytes:
-            vb = _exchange_varbytes_words(ctx, c.varbytes, targets, emit, d)
+            vb = _exchange_varbytes_words(ctx, c.varbytes, targets, emit,
+                                          d, meta)
             cols.append(Column(vb.lengths, c.dtype, v, None, c.name,
                                varbytes=vb))
         else:
@@ -382,6 +407,70 @@ def _join_plan_fn(mesh, join_type: _join.JoinType):
 
 
 _gather_side = _join.gather_columns
+
+
+@lru_cache(maxsize=None)
+def _join_plan_stream_fn(mesh, join_type: _join.JoinType, nk: int,
+                         a_desc, b_desc, block_rows: int, hash_mode: bool):
+    """Per-shard Pallas streaming join plan under shard_map — the same
+    kernel chain the local join uses (ops/join.plan_program_stream),
+    which the XLA per-shard plan was measured ~5x slower than at bench
+    scale. TPU-only (the interpreter inside jit is prohibitive)."""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+
+    def kernel(lkb, lkv, lemit, rkb, rkv, remit, ldat, lval, rdat, rval):
+        counts, a_streams, b_streams = _join._plan_program_stream_impl(
+            lkb, tuple([lkv] + [None] * (nk - 1)), lemit,
+            rkb, tuple([rkv] + [None] * (nk - 1)), remit,
+            ldat, lval, rdat, rval, (False,) * nk, join_type,
+            a_desc=a_desc, b_desc=b_desc, block_rows=block_rows,
+            hash_mode=hash_mode, interpret=False)
+        return (replicated_gather(counts, axis, world), counts,
+                a_streams, b_streams)
+
+    # check_vma off: pallas_call outputs carry no varying-mesh-axes
+    # annotation for the checker
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 10,
+                             out_specs=(P(), spec, spec, spec),
+                             check_vma=False))
+
+
+@lru_cache(maxsize=None)
+def _join_mat_stream_fn(mesh, join_type: _join.JoinType, cap_e: int,
+                        a_desc, b_desc, block_rows: int):
+    spec = P(mesh.axis_names[0])
+
+    def kernel(counts, a_streams, b_streams, ldat, lval, rdat, rval):
+        return _join._materialize_program_stream_impl(
+            counts, a_streams, b_streams, ldat, lval, rdat, rval,
+            join_type, cap_e, a_desc=a_desc, b_desc=b_desc,
+            block_rows=block_rows, interpret=False)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 7,
+                             out_specs=spec, check_vma=False))
+
+
+def _dist_stream_mode(lkb, rkb, join_type: _join.JoinType, world: int):
+    """None (XLA plan), or (hash_mode, block_rows) when the per-shard
+    Pallas stream join applies (same applicability shape as the local
+    join's router, on the post-exchange key-bit arrays)."""
+    if jax.default_backend() != "tpu" or _join.STREAM_PLAN is False:
+        return None
+    if join_type == _join.JoinType.FULL_OUTER:
+        return None
+    na = int(lkb[0].shape[0]) // world
+    nb = int(rkb[0].shape[0]) // world
+    if na == 0 or nb == 0 or na + nb >= (1 << 29):
+        return None
+    if len(lkb) == 1 and lkb[0].dtype.itemsize == 4 \
+            and lkb[0].dtype != jnp.bool_:
+        return (False, _join.stream_block_rows(na, nb))
+    lanes = sum(2 if b.dtype.itemsize == 8 else 1 for b in lkb)
+    if lanes <= _join.MAX_HASH_KEY_LANES:
+        return (True, _join.stream_block_rows(na, nb))
+    return None
 
 
 @lru_cache(maxsize=None)
@@ -562,11 +651,14 @@ def repartition(table: Table, ctx: CylonContext) -> Table:
 # distributed join (reference: DistributedJoin, table.cpp:656-696)
 # ---------------------------------------------------------------------------
 
-def distributed_join(left: Table, right: Table, config: _join.JoinConfig
-                     ) -> Table:
+def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
+                     force_exchange: bool = False) -> Table:
+    """``force_exchange``: run the full shuffle+join composition even on
+    a 1-wide mesh / co-partitioned inputs (the all_to_all still executes)
+    — used by bench.py to time the honest distributed path on one chip."""
     ctx = left._ctx
     world = ctx.get_world_size()
-    if world == 1:
+    if world == 1 and not (force_exchange and ctx.is_distributed()):
         # reference parity: world==1 short-circuits to the local join
         # (table.cpp:662-669)
         return table_mod.join(left, right, config)
@@ -582,7 +674,8 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig
         for t, kcols, kidx in ((left_d, lcols, lidx), (right_d, rcols, ridx)):
             bits, kv, h1s = _dist_key_bits(ctx, kcols)
             sig = shard.partition_signature(kcols, kidx, world)
-            if sig is not None and t._hash_partitioned == sig:
+            if sig is not None and t._hash_partitioned == sig \
+                    and not force_exchange:
                 # co-partitioned (prior shuffle or distribute_by_key host
                 # ingest): rows are already hash-placed — skip the exchange
                 shuffled.append((tuple(shard.pin(b, ctx) for b in bits),
@@ -606,22 +699,46 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig
     rval = tuple(shard.pin(c.valid_mask(), ctx) for c in rcols_s)
 
     jt = config.type
-    with _phase("distributed_join.plan", seq):
-        counts2, lo, m, bperm, un_mask = _join_plan_fn(ctx.mesh, jt)(
-            lkb, lkv, lemit, rkb, rkv, remit)
-        aemit = remit if jt == _join.JoinType.RIGHT else lemit
-        # counts2 is the replicated [world, 2] matrix of per-shard
-        # [n_primary, n_unmatched_b]; capacity = worst shard (all shards
-        # share one program)
-        counts = np.asarray(jax.device_get(counts2)).reshape(world, 2)
-    cap_p = _capacity(int(counts[:, 0].max()))
-    cap_u = _capacity(int(counts[:, 1].max())) \
-        if jt == _join.JoinType.FULL_OUTER else 0
+    res = None
+    mode = _dist_stream_mode(lkb, rkb, jt, world)
+    if mode is not None:
+        hash_mode, br = mode
+        a_desc, b_desc = _join.plan_lane_descs(ldat, lval, rdat, rval, jt)
+        with _phase("distributed_join.plan", seq):
+            rep_counts, counts_dev, a_streams, b_streams = \
+                _join_plan_stream_fn(ctx.mesh, jt, len(lkb), a_desc,
+                                     b_desc, br, hash_mode)(
+                    lkb, lkv, lemit, rkb, rkv, remit,
+                    ldat, lval, rdat, rval)
+            cm = np.asarray(jax.device_get(rep_counts)).reshape(world, -1)
+        if not (hash_mode and int(cm[:, 3].sum()) > 0):
+            cap_e = _join.stream_expand_capacity(int(cm[:, 0].max()), br)
+            with _phase("distributed_join.materialize", seq):
+                res = _join_mat_stream_fn(
+                    ctx.mesh, jt, cap_e, a_desc, b_desc, br)(
+                    counts_dev, a_streams, b_streams,
+                    ldat, lval, rdat, rval)
+        # else: 64-bit hash collision — recompute via the exact XLA plan
 
-    with _phase("distributed_join.materialize", seq):
-        lod, lov, rod, rov, emit, lidx_o, ridx_o = _join_mat_fn(
-            ctx.mesh, jt, cap_p, cap_u)(
-            lo, m, bperm, un_mask, aemit, ldat, lval, rdat, rval)
+    if res is not None:
+        lod, lov, rod, rov, emit, lidx_o, ridx_o = res
+    else:
+        with _phase("distributed_join.plan", seq):
+            counts2, lo, m, bperm, un_mask = _join_plan_fn(ctx.mesh, jt)(
+                lkb, lkv, lemit, rkb, rkv, remit)
+            aemit = remit if jt == _join.JoinType.RIGHT else lemit
+            # counts2 is the replicated [world, 2] matrix of per-shard
+            # [n_primary, n_unmatched_b]; capacity = worst shard (all
+            # shards share one program)
+            counts = np.asarray(jax.device_get(counts2)).reshape(world, 2)
+        cap_p = _capacity(int(counts[:, 0].max()))
+        cap_u = _capacity(int(counts[:, 1].max())) \
+            if jt == _join.JoinType.FULL_OUTER else 0
+
+        with _phase("distributed_join.materialize", seq):
+            lod, lov, rod, rov, emit, lidx_o, ridx_o = _join_mat_fn(
+                ctx.mesh, jt, cap_p, cap_u)(
+                lo, m, bperm, un_mask, aemit, ldat, lval, rdat, rval)
 
     nl = left_d.column_count
     cols = _rebuild_columns(lod, lov, lcols_s,
@@ -948,15 +1065,60 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
 
 
 # ---------------------------------------------------------------------------
-# distributed groupby (reference: GroupBy, groupby/groupby.cpp:96-139;
-# the reference pre-aggregates then re-applies the same op — which makes
-# distributed COUNT wrong (SURVEY §3.2). Here the shuffle co-locates all
-# rows of a key first, so ONE aggregation pass is both correct and simple;
-# pre-aggregation is a future bandwidth optimization.)
+# distributed groupby (reference: GroupBy, groupby/groupby.cpp:96-139 —
+# local partial aggregation BEFORE the shuffle so exchanged bytes scale
+# with groups, not rows; unlike the reference, partials merge with the
+# CORRECT second-phase op — COUNT partials SUM, MEAN carries (sum, count)
+# pairs — fixing the reference's COUNT-of-partials bug, SURVEY §3.2.)
 # ---------------------------------------------------------------------------
 
+
+def _groupby_shuffle_agg(ctx: CylonContext, key_columns, value_columns,
+                         ops: Tuple, emit, seq):
+    """Shuffle rows by key hash, then aggregate per shard. Returns
+    (key_out_cols, agg list of (arr, valid), gvalid)."""
+    with _phase("distributed_groupby.shuffle", seq):
+        view = Table(list(key_columns) + list(value_columns), ctx, None)
+        extra = {}
+        nbits = 0
+        h1s = []
+        for c in key_columns:
+            b, h1 = _dist_col_keys(ctx, c)
+            h1s.append(h1)
+            for arr in b:
+                extra[f"kb{nbits}"] = arr
+                nbits += 1
+        targets = shard.pin(_targets_from_hashes(ctx, h1s), ctx)
+        out_cols, emit_s, xout = _exchange_table(view, targets, emit, ctx,
+                                                 extra)
+
+    nk = len(key_columns)
+    kcols_s = out_cols[:nk]
+    vcols_s = out_cols[nk:]
+    kbits = tuple(xout[f"kb{j}"] for j in range(nbits))
+    kdat = tuple(shard.pin(c.data, ctx) for c in kcols_s)
+    kval = tuple(shard.pin(c.valid_mask(), ctx) for c in kcols_s)
+    vdat = tuple(shard.pin(c.data, ctx) for c in vcols_s)
+    vval = tuple(shard.pin(c.valid_mask(), ctx) for c in vcols_s)
+
+    with _phase("distributed_groupby.aggregate", seq):
+        kout, kvout, gvalid, agg, safe = _groupby_fn(ctx.mesh, ops)(
+            kbits, kdat, kval, emit_s, vdat, vval)
+
+    key_out = []
+    for d, v, kc in zip(kout, kvout, kcols_s):
+        if kc.is_varbytes:
+            vb = _varlen_take_sharded(ctx, kc.varbytes, safe)
+            key_out.append(Column(vb.lengths, kc.dtype, v, None, kc.name,
+                                  varbytes=vb))
+        else:
+            key_out.append(Column(d, kc.dtype, v, kc.dictionary, kc.name))
+    return key_out, list(agg), gvalid
+
+
 def distributed_groupby(table: Table, index_col, aggregate_cols: List,
-                        aggregate_ops: List[_groupby.AggregationOp]) -> Table:
+                        aggregate_ops: List[_groupby.AggregationOp],
+                        pre_aggregate: bool = True) -> Table:
     ctx = table._ctx
     world = ctx.get_world_size()
     if world == 1:
@@ -975,52 +1137,104 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
                              "varbytes value columns support COUNT only")
 
     seq = ctx.get_next_sequence()
-    with _phase("distributed_groupby.shuffle", seq):
-        # key+value columns ride one exchange as a view table; key bit
-        # lanes (hash quads for varbytes) ride as extra payload
-        view_cols = key_columns + [t._columns[vi] for vi in val_cols]
-        view = Table(list(view_cols), ctx, t.row_mask)
-        extra = {}
-        nbits = 0
-        h1s = []
-        for c in key_columns:
-            b, h1 = _dist_col_keys(ctx, c)
-            h1s.append(h1)
-            for arr in b:
-                extra[f"kb{nbits}"] = arr
-                nbits += 1
-        targets = shard.pin(_targets_from_hashes(ctx, h1s), ctx)
-        out_cols, emit, xout = _exchange_table(
-            view, targets, shard.pin(t.emit_mask(), ctx), ctx, extra)
+    ops = list(aggregate_ops)
+    emit = shard.pin(t.emit_mask(), ctx)
+    MEAN = _groupby.AggregationOp.MEAN
+    SUM = _groupby.AggregationOp.SUM
+    COUNT = _groupby.AggregationOp.COUNT
 
-    nk, nv = len(idx_cols), len(val_cols)
-    kcols_s = out_cols[:nk]
-    vcols_s = out_cols[nk:]
-    kbits = tuple(xout[f"kb{j}"] for j in range(nbits))
-    kdat = tuple(shard.pin(c.data, ctx) for c in kcols_s)
-    kval = tuple(shard.pin(c.valid_mask(), ctx) for c in kcols_s)
-    vdat = tuple(shard.pin(c.data, ctx) for c in vcols_s)
-    vval = tuple(shard.pin(c.valid_mask(), ctx) for c in vcols_s)
+    if not pre_aggregate:
+        value_columns = [t._columns[vi] for vi in val_cols]
+        key_out, agg, gvalid = _groupby_shuffle_agg(
+            ctx, key_columns, value_columns, tuple(ops), emit, seq)
+        cols = list(key_out)
+        for (arr, av), vi, op in zip(agg, val_cols, ops):
+            src = t._columns[vi]
+            keep_dict = (op in (_groupby.AggregationOp.MIN,
+                                _groupby.AggregationOp.MAX)
+                         and src.is_string)
+            cols.append(Column(arr, table_mod._agg_dtype(src, op), av,
+                               src.dictionary if keep_dict else None,
+                               src.name))
+        return Table(cols, ctx, gvalid)
 
-    ops = tuple(aggregate_ops)
-    with _phase("distributed_groupby.aggregate", seq):
-        kout, kvout, gvalid, agg, safe = _groupby_fn(ctx.mesh, ops)(
-            kbits, kdat, kval, emit, vdat, vval)
-
-    cols = []
-    for d, v, kc in zip(kout, kvout, kcols_s):
-        if kc.is_varbytes:
-            vb = _varlen_take_sharded(ctx, kc.varbytes, safe)
-            cols.append(Column(vb.lengths, kc.dtype, v, None, kc.name,
-                               varbytes=vb))
+    # ---- phase A: per-shard partial aggregation (shuffle bytes then
+    # scale with per-shard GROUPS, not rows). MEAN expands to
+    # (f64 SUM, COUNT) partial pairs; phase B merges with the correct
+    # second-phase op (COUNT partials are SUMmed).
+    a_entries = []   # (orig_pos, opA, cast_f64)
+    b_ops = []
+    out_map = []     # per original op: ("d", a_idx) | ("mean", si, ci)
+    for j, op in enumerate(ops):
+        if op == MEAN:
+            out_map.append(("mean", len(a_entries), len(a_entries) + 1))
+            a_entries += [(j, SUM, True), (j, COUNT, False)]
+            b_ops += [SUM, SUM]
         else:
-            cols.append(Column(d, kc.dtype, v, kc.dictionary, kc.name))
-    for (arr, av), vi, op in zip(agg, val_cols, aggregate_ops):
+            out_map.append(("d", len(a_entries)))
+            a_entries.append((j, op, False))
+            b_ops.append(_groupby.second_phase_op(op))
+
+    with _phase("distributed_groupby.pre_aggregate", seq):
+        kbitsA = []
+        for c in key_columns:
+            b, _h1 = _dist_col_keys(ctx, c)
+            kbitsA.extend(b)
+        kbitsA = tuple(shard.pin(b, ctx) for b in kbitsA)
+        kdatA = tuple(shard.pin(c.data, ctx) for c in key_columns)
+        kvalA = tuple(shard.pin(c.valid_mask(), ctx) for c in key_columns)
+        vdatA, vvalA = [], []
+        for j, _opA, cast in a_entries:
+            src = t._columns[val_cols[j]]
+            d = src.data.astype(jnp.float64) if cast else src.data
+            vdatA.append(shard.pin(d, ctx))
+            vvalA.append(shard.pin(src.valid_mask(), ctx))
+        opsA = tuple(opA for _j, opA, _c in a_entries)
+        koutA, kvoutA, gvalidA, aggA, safeA = _groupby_fn(
+            ctx.mesh, opsA)(kbitsA, kdatA, kvalA, emit,
+                            tuple(vdatA), tuple(vvalA))
+
+    pkey_cols = []
+    for d, v, kc in zip(koutA, kvoutA, key_columns):
+        if kc.is_varbytes:
+            vb = _varlen_take_sharded(ctx, kc.varbytes, safeA)
+            pkey_cols.append(Column(vb.lengths, kc.dtype, v, None, kc.name,
+                                    varbytes=vb))
+        else:
+            pkey_cols.append(Column(d, kc.dtype, v, kc.dictionary, kc.name))
+    pval_cols = []
+    for (arr, av), (j, opA, cast) in zip(aggA, a_entries):
+        src = t._columns[val_cols[j]]
+        dt = dtypes.Double() if cast else table_mod._agg_dtype(src, opA)
+        keep_dict = (opA in (_groupby.AggregationOp.MIN,
+                             _groupby.AggregationOp.MAX)
+                     and src.is_string)
+        pval_cols.append(Column(arr, dt, av,
+                                src.dictionary if keep_dict else None,
+                                src.name))
+
+    # ---- phase B: shuffle the partials, merge with second-phase ops
+    key_out, aggB, gvalid = _groupby_shuffle_agg(
+        ctx, pkey_cols, pval_cols, tuple(b_ops), gvalidA, seq)
+
+    cols = list(key_out)
+    for op, vi, m in zip(ops, val_cols, out_map):
         src = t._columns[vi]
-        keep_dict = (op in (_groupby.AggregationOp.MIN,
-                            _groupby.AggregationOp.MAX) and src.is_string)
-        cols.append(Column(arr, table_mod._agg_dtype(src, op), av,
-                           src.dictionary if keep_dict else None, src.name))
+        if m[0] == "mean":
+            s_arr, s_av = aggB[m[1]]
+            c_arr, c_av = aggB[m[2]]
+            data = s_arr / jnp.maximum(c_arr.astype(jnp.float64), 1)
+            av = s_av & c_av & (c_arr > 0)
+            cols.append(Column(data, table_mod._agg_dtype(src, op), av,
+                               None, src.name))
+        else:
+            arr, av = aggB[m[1]]
+            keep_dict = (op in (_groupby.AggregationOp.MIN,
+                                _groupby.AggregationOp.MAX)
+                         and src.is_string)
+            cols.append(Column(arr, table_mod._agg_dtype(src, op), av,
+                               src.dictionary if keep_dict else None,
+                               src.name))
     return Table(cols, ctx, gvalid)
 
 

@@ -52,9 +52,14 @@ from ..telemetry import phase as _phase
 from ..util import pow2 as _pow2
 
 # Upper bound on the per-round block (rows per (src,dst) pair per round).
-# Comm/scratch memory per leaf is 2*W*MAX_BLOCK rows; skew beyond this
-# degrades into more rounds, not bigger buffers.
-MAX_BLOCK = 1 << 16
+# Comm/scratch memory per leaf is 2*W*MAX_BLOCK rows; the memory-pool
+# budget (comm_budget_bytes, real HBM stats on TPU) shrinks the block to
+# fit, so this cap only matters where stats are unavailable. Skew beyond
+# the budgeted block degrades into more rounds, not bigger buffers.
+# (1<<16 was measured 64 rounds = 5x slower than one round at 4M rows on
+# a 1-wide v5e mesh — round count, not block memory, was the binding
+# constraint.)
+MAX_BLOCK = 1 << 22
 
 
 def replicated_gather(x, axis: str, world: int):
@@ -69,6 +74,22 @@ def replicated_gather(x, axis: str, world: int):
     row = jax.lax.axis_index(axis)
     mat = jnp.zeros((world,) + x.shape, x.dtype).at[row].set(x)
     return jax.lax.psum(mat, axis)
+
+
+# beyond this world size, per-target compare-sum passes cost more than
+# one scatter-class segment_sum
+_COUNT_COMPARE_MAX_W = 64
+
+
+def _target_counts(t, world):
+    """counts[w] = #rows with target w. Compare-sum for small W (W cheap
+    vector passes; segment_sum's scatter costs ~15-30 ns/element on TPU
+    and was measured at ~0.3 s per 16M-row count phase)."""
+    if world <= _COUNT_COMPARE_MAX_W:
+        return jnp.stack(
+            [(t == w).sum(dtype=jnp.int32) for w in range(world)])
+    return jax.ops.segment_sum(jnp.ones(t.shape[0], jnp.int32), t,
+                               num_segments=world + 1)[:world]
 
 
 @lru_cache(maxsize=None)
@@ -86,33 +107,117 @@ def _count_fn(mesh):
 
     def kernel(targets, emit):
         t = jnp.where(emit, targets.astype(jnp.int32), world)
-        counts = jax.ops.segment_sum(jnp.ones(t.shape[0], jnp.int32), t,
-                                     num_segments=world + 1)
-        return replicated_gather(counts[:world], axis, world)
+        return replicated_gather(_target_counts(t, world), axis, world)
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec),
                              out_specs=P()))
 
 
+def _to_varying_fn(axis):
+    _vary = getattr(jax.lax, "pcast", None)
+    if _vary is not None:
+        return lambda x: jax.lax.pcast(x, axis, to="varying")
+    return lambda x: jax.lax.pvary(x, (axis,))  # pragma: no cover
+
+
+def _bucket_sort(payload, targets, emit, world):
+    """Stable bucket sort by target: ONE fused device sort carries every
+    1-D payload leaf as a sort OPERAND (the reference's per-dtype split
+    kernels, arrow_kernels.cpp:24-134, collapse into this sort). Payload
+    operands ride the sort at near-memcpy bandwidth; a per-leaf
+    take(perm) gather costs ~15-30 ns/element on TPU and was measured
+    dominating the whole exchange. Non-1-D leaves (rare) fall back to
+    the gather. Returns (sorted leaves, counts_out, start offsets)."""
+    n = targets.shape[0]
+    t = jnp.where(emit, targets.astype(jnp.int32), world)
+    leaves, treedef = jax.tree.flatten(payload)
+    ride = [x.ndim == 1 for x in leaves]
+    ops = tuple(x for x, r in zip(leaves, ride) if r)
+    need_perm = not all(ride)
+    # stability is load-bearing: the varbytes word/row exchanges must
+    # keep matching within-source order (previously via an iota tiebreak)
+    if need_perm:
+        iota = jnp.arange(n, dtype=jnp.int32)
+        res = jax.lax.sort((t,) + ops + (iota,), num_keys=1,
+                           is_stable=True)
+        perm = res[-1]
+        sorted_ops = list(res[1:-1])
+    else:
+        res = jax.lax.sort((t,) + ops, num_keys=1, is_stable=True)
+        sorted_ops = list(res[1:])
+    out_leaves = []
+    k = 0
+    for x, r in zip(leaves, ride):
+        if r:
+            out_leaves.append(sorted_ops[k])
+            k += 1
+        else:
+            out_leaves.append(jnp.take(x, perm, axis=0))
+    counts_out = _target_counts(t, world)
+    start = jnp.cumsum(counts_out) - counts_out
+    return jax.tree.unflatten(treedef, out_leaves), counts_out, start
+
+
+def _send_block(xs, start, o, block, world):
+    """[world, block] send stack via ONE contiguous dynamic slice per
+    target — rows are target-bucket-sorted, so sends are slices, never
+    gathers (XLA gathers cost ~15-30 ns/element; slices are memcpys).
+    ``xs`` must be pre-padded by ``block`` so slices stay in range;
+    over-read rows belong to other targets and are dropped receive-side."""
+    outs = []
+    for t in range(world):
+        pos = jnp.clip(start[t] + o, 0, xs.shape[0] - block)
+        outs.append(jax.lax.dynamic_slice_in_dim(xs, pos, block, axis=0))
+    return jnp.stack(outs)
+
+
+@lru_cache(maxsize=None)
+def _exchange_padded_fn(mesh, block: int):
+    """Scatter-free single-shot exchange: every (src,dst) pair moves ONE
+    [block] slice and lands at the STATIC slot dst_out[src*block:...] —
+    no receive scatter at all. Output is PADDED per source (emit mask
+    marks each source's live prefix), capacity world*block; the host
+    routes here when that padding is acceptable (see exchange())."""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+    cap_out = world * block
+
+    def kernel(payload, targets, emit):
+        sorted_leaves, counts_out, start = _bucket_sort(
+            payload, targets, emit, world)
+        counts_in = jax.lax.all_to_all(counts_out, axis, split_axis=0,
+                                       concat_axis=0, tiled=True)
+
+        def one(xs):
+            pad = jnp.zeros((block,) + xs.shape[1:], xs.dtype)
+            xp = jnp.concatenate([xs, pad])
+            send = _send_block(xp, start, 0, block, world)
+            recv = jax.lax.all_to_all(send, axis, split_axis=0,
+                                      concat_axis=0, tiled=False)
+            return recv.reshape((cap_out,) + xs.shape[1:])
+
+        outs = jax.tree.map(one, sorted_leaves)
+        pos = jnp.arange(cap_out, dtype=jnp.int32)
+        new_emit = (pos % block) < jnp.take(counts_in, pos // block)
+        return outs, new_emit, counts_in
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec))
+
+
 @lru_cache(maxsize=None)
 def _exchange_fn(mesh, block: int, rounds: int, cap_out: int):
-    """The body phase: bucket-sort by target once, then K blockwise
-    `all_to_all` rounds compacting into a [cap_out] output per leaf."""
+    """The blockwise body phase (skew fallback): K rounds, each moving
+    one [W,B] block per leaf and compacting received rows at running
+    per-source offsets — bounded comm memory under any skew."""
     axis = mesh.axis_names[0]
     world = mesh.devices.size
     spec = P(axis)
 
     def kernel(payload, targets, emit):
-        n = targets.shape[0]
-        iota = jnp.arange(n, dtype=jnp.int32)
-        t = jnp.where(emit, targets.astype(jnp.int32), world)
-        # stable bucket sort by target: one fused device sort yields the
-        # permutation every column reuses (the reference's per-dtype split
-        # kernels, arrow_kernels.cpp:24-134, collapse into this one sort)
-        _, perm = jax.lax.sort((t, iota), num_keys=1)
-        counts_out = jax.ops.segment_sum(jnp.ones(n, jnp.int32), t,
-                                         num_segments=world + 1)[:world]
-        start = jnp.cumsum(counts_out) - counts_out
+        sorted_leaves, counts_out, start = _bucket_sort(
+            payload, targets, emit, world)
         # the header exchange, on device: each shard learns how many rows
         # every source will send it, and writes source s's rows at offset
         # S[s] — arrivals are contiguous per source, output is compact
@@ -122,59 +227,72 @@ def _exchange_fn(mesh, block: int, rounds: int, cap_out: int):
         total_in = counts_in.sum()
 
         biota = jnp.arange(block, dtype=jnp.int32)[None, :]      # [1,B]
-        sorted_leaves = jax.tree.map(
-            lambda x: jnp.take(x, perm, axis=0), payload)
-        # the carry must be typed as mesh-varying, like the all_to_all
-        # outputs accumulated into it
-        _vary = getattr(jax.lax, "pcast", None)
-        if _vary is not None:
-            def _to_varying(x):
-                return jax.lax.pcast(x, axis, to="varying")
-        else:  # pragma: no cover - older jax
-            def _to_varying(x):
-                return jax.lax.pvary(x, (axis,))
+        padded = jax.tree.map(
+            lambda x: jnp.concatenate(
+                [x, jnp.zeros((block,) + x.shape[1:], x.dtype)]),
+            sorted_leaves)
+        _to_varying = _to_varying_fn(axis)
         out0 = jax.tree.map(
             lambda x: _to_varying(jnp.zeros((cap_out,) + x.shape[1:],
                                             x.dtype)), payload)
 
         def round_body(k, outs):
             o = k * block
-            # send slots: rows [o, o+B) of each target's bucket
-            gsafe = jnp.clip(start[:, None] + o + biota, 0, max(n - 1, 0))
             # receive slots: S[s] + [o, o+B), dropped past counts_in[s]
             pos = S[:, None] + o + biota
             pvalid = (o + biota) < counts_in[:, None]
             psafe = jnp.where(pvalid, pos, cap_out).reshape(-1)
 
             def one(xs, out):
-                send = jnp.take(xs, gsafe.reshape(-1), axis=0)
-                send = send.reshape((world, block) + xs.shape[1:])
+                send = _send_block(xs, start, o, block, world)
                 recv = jax.lax.all_to_all(send, axis, split_axis=0,
                                           concat_axis=0, tiled=False)
                 flat = recv.reshape((world * block,) + xs.shape[1:])
                 return out.at[psafe].set(flat, mode="drop")
 
-            return jax.tree.map(one, sorted_leaves, outs)
+            return jax.tree.map(one, padded, outs)
 
         outs = jax.lax.fori_loop(0, rounds, round_body, out0) if rounds > 1 \
             else round_body(0, out0)
         new_emit = jnp.arange(cap_out, dtype=jnp.int32) < total_in
-        return outs, new_emit
+        counts_in_out = counts_in
+        return outs, new_emit, counts_in_out
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec))
 
 
+# padded-mode acceptance: worst-case capacity blowup over the compact
+# layout before the blockwise (skew) path takes over. Uniform hash
+# placement gives W*pow2(max_pair) <= 2*pow2(recv_max); a hot (src,dst)
+# pair blows past 2 and routes to the blockwise path.
+PADDED_WASTE_FACTOR = 2
+
+
 def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
              emit: jnp.ndarray, ctx: CylonContext,
              max_block: Optional[int] = None
-             ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, int]:
+             ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, int, dict]:
     """Shuffle a pytree of row-sharded per-row arrays to their target shards.
 
-    Returns (exchanged payload, new emit mask, per-shard capacity). All
-    outputs are row-sharded and COMPACT per shard (live rows form a
-    leading prefix). Capacity = pow2 of the worst per-shard receive total.
-    ``max_block`` caps the per-round block size (default MAX_BLOCK).
+    Returns (exchanged payload, new emit mask, per-shard capacity, meta).
+    All outputs are row-sharded with each source's rows CONTIGUOUS and
+    in stable order; live rows are marked by the emit mask. Two layouts,
+    host-selected from the count matrix:
+
+    * "padded" (the fast path): every (src,dst) pair moves one slice and
+      lands at a static slot — no receive scatter. Source s's rows start
+      at s*block; capacity world*block. Picked when that padding stays
+      within PADDED_WASTE_FACTOR of the compact capacity (uniform-ish
+      distributions, which hash placement makes the common case).
+    * "compact" (skew fallback): blockwise rounds with bounded comm
+      buffers; live rows form a leading prefix, capacity pow2 of the
+      worst receive total.
+
+    meta = {"mode", "block", "counts_in"} — counts_in is the [world*W]
+    sharded per-source receive-count matrix (each shard's own [W] slice),
+    consumed by the varbytes word/row layout reconciliation.
+    ``max_block`` caps the per-round blockwise block size.
     """
     world = ctx.get_world_size()
     seq = ctx.get_next_sequence()
@@ -184,24 +302,34 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
     max_pair = int(counts.max()) if counts.size else 0
     recv_max = int(counts.sum(axis=0).max()) if counts.size else 0
     mb = max_block if max_block is not None else MAX_BLOCK
-    # the memory pool bounds in-flight comm buffers (2*W*block rows per
-    # leaf both directions); shrink the block cap to fit the HBM budget —
-    # the reference's analog is the Allocator feeding receive buffers from
-    # the pool (arrow_all_to_all.cpp:234-247)
+    # the memory pool bounds in-flight comm buffers; shrink the block cap
+    # to fit the HBM budget — the reference's analog is the Allocator
+    # feeding receive buffers from the pool (arrow_all_to_all.cpp:234-247)
     budget = ctx.memory_pool.comm_budget_bytes()
+    bytes_per_row = sum(
+        int(np.dtype(x.dtype).itemsize) * int(np.prod(x.shape[1:]))
+        for x in jax.tree.leaves(payload)) or 4
     if budget:
-        bytes_per_row = sum(
-            int(np.dtype(x.dtype).itemsize) * int(np.prod(x.shape[1:]))
-            for x in jax.tree.leaves(payload)) or 4
         while mb > 1024 and 4 * world * mb * bytes_per_row > budget:
             mb //= 2
     # floor-pow2 the cap so the documented memory bound is never exceeded
     mb = 1 << (max(int(mb), 1).bit_length() - 1)
-    block = min(_pow2(max_pair), mb)
-    # pow2 round count bounds the compile cache to O(log^3) programs
-    rounds = _pow2(-(-max(max_pair, 1) // block))
-    cap_out = _pow2(recv_max)
+
+    block_p = _pow2(max_pair)
+    cap_padded = world * block_p
+    cap_compact = _pow2(recv_max)
+    padded_ok = (cap_padded <= PADDED_WASTE_FACTOR * max(cap_compact, 1)
+                 and block_p <= mb)
     with _phase("shuffle.exchange", seq):
-        out, new_emit = _exchange_fn(ctx.mesh, block, rounds, cap_out)(
-            payload, targets, emit)
-    return out, new_emit, cap_out
+        if padded_ok:
+            out, new_emit, counts_in = _exchange_padded_fn(
+                ctx.mesh, block_p)(payload, targets, emit)
+            return out, new_emit, cap_padded, {
+                "mode": "padded", "block": block_p, "counts_in": counts_in}
+        block = min(block_p, mb)
+        # pow2 round count bounds the compile cache to O(log^3) programs
+        rounds = _pow2(-(-max(max_pair, 1) // block))
+        out, new_emit, counts_in = _exchange_fn(
+            ctx.mesh, block, rounds, cap_compact)(payload, targets, emit)
+    return out, new_emit, cap_compact, {
+        "mode": "compact", "block": 0, "counts_in": counts_in}

@@ -292,8 +292,12 @@ def test_multi_round_exchange_matches_single(dist_ctx):
     emit = _shard.pin(t.emit_mask(), dist_ctx)
     payload = {"a": _shard.pin(t.get_column(0).data, dist_ctx),
                "b": _shard.pin(t.get_column(1).data, dist_ctx)}
-    big, be, _ = exchange(payload, targets, emit, dist_ctx)
-    small, se, _ = exchange(payload, targets, emit, dist_ctx, max_block=64)
+    big, be, _, bmeta = exchange(payload, targets, emit, dist_ctx)
+    small, se, _, smeta = exchange(payload, targets, emit, dist_ctx,
+                                   max_block=64)
+    # tiny max_block forces the blockwise (compact) path; the default
+    # uniform case takes the scatter-free padded path
+    assert smeta["mode"] == "compact"
     ba = np.asarray(jax.device_get(big["a"]))[np.asarray(jax.device_get(be))]
     sa = np.asarray(jax.device_get(small["a"]))[np.asarray(jax.device_get(se))]
     bb = np.asarray(jax.device_get(big["b"]))[np.asarray(jax.device_get(be))]

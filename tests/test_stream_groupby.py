@@ -1,0 +1,185 @@
+"""Streaming groupby (fused sort + Pallas groupby_stream) vs the XLA
+segment path, via the public groupby API under the Pallas interpreter."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.ops import groupby as _groupby
+
+# interpreter-heavy Pallas kernels: excluded from the quick tier
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture
+def ctx():
+    return ct.CylonContext.Init()
+
+
+def _both(t, idx, cols, ops):
+    old = _groupby.STREAM_GROUPBY
+    try:
+        _groupby.STREAM_GROUPBY = False
+        ref = t.groupby(idx, cols, ops)
+        _groupby.STREAM_GROUPBY = True
+        got = t.groupby(idx, cols, ops)
+    finally:
+        _groupby.STREAM_GROUPBY = old
+    return ref.to_pandas(), got.to_pandas()
+
+
+def _norm(df):
+    df = df.copy()
+    df.columns = range(df.shape[1])
+    for c in df.columns:
+        if df[c].dtype.kind == "f":
+            df[c] = df[c].round(4)
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+def assert_same(ref, got):
+    pd.testing.assert_frame_equal(_norm(got), _norm(ref),
+                                  check_dtype=False, atol=1e-3)
+
+
+def test_stream_groupby_all_ops(ctx):
+    rng = np.random.default_rng(0)
+    n = 4000
+    t = ct.Table.from_pydict(ctx, {
+        "g": rng.integers(0, 113, n).astype(np.int32),
+        "x": rng.normal(size=n).astype(np.float32),
+        "y": rng.integers(-50, 50, n).astype(np.int32),
+    })
+    ref, got = _both(t, 0, [1, 2, 1, 2, 1],
+                     ["sum", "min", "max", "count", "mean"])
+    assert_same(ref, got)
+
+
+def test_stream_groupby_null_keys_and_values(ctx):
+    rng = np.random.default_rng(1)
+    n = 1200
+    g = rng.integers(0, 37, n).astype(np.float64)
+    g[rng.random(n) < 0.1] = np.nan  # null keys group together
+    x = rng.normal(size=n)
+    xm = x.copy()
+    xm[rng.random(n) < 0.2] = np.nan  # null values skipped
+    df = pd.DataFrame({"g": g.astype(np.float32),
+                       "x": xm.astype(np.float32)})
+    t = ct.Table.from_pandas(ctx, df)
+    ref, got = _both(t, 0, [1, 1], ["sum", "count"])
+    assert_same(ref, got)
+
+
+def test_stream_groupby_multikey_exact(ctx):
+    rng = np.random.default_rng(2)
+    n = 2500
+    t = ct.Table.from_pydict(ctx, {
+        "a": rng.integers(0, 9, n).astype(np.int32),
+        "b": rng.integers(0, 7, n).astype(np.int32),
+        "x": rng.integers(0, 1000, n).astype(np.int32),
+    })
+    ref, got = _both(t, [0, 1], [2, 2], ["sum", "max"])
+    assert_same(ref, got)
+
+
+def test_stream_groupby_wide_key_hash_mode(ctx):
+    """5 int64 keys -> 10 lanes > MAX_GROUP_KEY_LANES: hash mode with
+    verify lanes."""
+    rng = np.random.default_rng(3)
+    n = 1500
+    cols = {f"k{j}": rng.integers(0, 4, n).astype(np.int64)
+            for j in range(5)}
+    cols["x"] = rng.integers(0, 100, n).astype(np.int32)
+    t = ct.Table.from_pydict(ctx, cols)
+    ref, got = _both(t, [0, 1, 2, 3, 4], [5], ["sum"])
+    assert_same(ref, got)
+
+
+def test_stream_groupby_hash_collision_falls_back(ctx, monkeypatch):
+    import jax.numpy as jnp
+
+    from cylon_tpu.ops import hash as _hash
+
+    monkeypatch.setattr(_hash, "fmix32", lambda h: h * jnp.uint32(0))
+    monkeypatch.setattr(_hash, "fmix32b", lambda h: h * jnp.uint32(0))
+    rng = np.random.default_rng(4)
+    n = 600
+    cols = {f"k{j}": rng.integers(0, 3, n).astype(np.int64)
+            for j in range(5)}
+    cols["x"] = rng.integers(0, 100, n).astype(np.int32)
+    t = ct.Table.from_pydict(ctx, cols)
+    ref, got = _both(t, [0, 1, 2, 3, 4], [5], ["sum"])
+    assert_same(ref, got)
+
+
+def test_stream_groupby_masked_rows(ctx):
+    rng = np.random.default_rng(5)
+    n = 1400
+    t = ct.Table.from_pydict(ctx, {
+        "g": rng.integers(0, 31, n).astype(np.int32),
+        "x": rng.integers(0, 100, n).astype(np.int32),
+    })
+    f = t.filter_mask(t.get_column(1).data < 60)
+    ref, got = _both(f, 0, [1, 1], ["sum", "count"])
+    assert_same(ref, got)
+
+
+def test_stream_groupby_single_group_and_tiny(ctx):
+    t = ct.Table.from_pydict(ctx, {
+        "g": np.zeros(5, np.int32),
+        "x": np.arange(5, dtype=np.int32)})
+    ref, got = _both(t, 0, [1, 1, 1], ["sum", "min", "max"])
+    assert_same(ref, got)
+    t1 = ct.Table.from_pydict(ctx, {
+        "g": np.array([7], np.int32), "x": np.array([3], np.int32)})
+    ref, got = _both(t1, 0, [1], ["mean"])
+    assert_same(ref, got)
+
+
+def test_stream_groupby_block_boundary_runs(ctx):
+    """Runs spanning block boundaries (block_rows=8 -> 1024-element
+    blocks): one giant run + many tiny ones."""
+    n = 3000
+    g = np.concatenate([np.zeros(1500, np.int32),
+                        np.arange(1, 1501, dtype=np.int32)])
+    rng = np.random.default_rng(6)
+    x = rng.integers(0, 10, n).astype(np.int32)
+    t = ct.Table.from_pydict(ctx, {"g": g, "x": x})
+    ref, got = _both(t, 0, [1, 1], ["sum", "count"])
+    assert_same(ref, got)
+
+
+def test_stream_groupby_string_keys(ctx):
+    rng = np.random.default_rng(7)
+    vocab = np.array([f"cat{j}" for j in range(23)], dtype=object)
+    t = ct.Table.from_pydict(ctx, {
+        "s": vocab[rng.integers(0, 23, 900)],
+        "x": rng.integers(0, 50, 900).astype(np.int32)})
+    ref, got = _both(t, 0, [1, 1], ["sum", "max"])
+    assert_same(ref, got)
+
+
+def test_stream_groupby_int_mean_falls_back_correct(ctx):
+    """Integer MEAN must not stream (the sum lane would wrap int32): a
+    group summing past 2^31 still gets the exact mean."""
+    n = 3000
+    t = ct.Table.from_pydict(ctx, {
+        "g": np.zeros(n, np.int32),
+        "x": np.full(n, 2_000_000, np.int32)})
+    ref, got = _both(t, 0, [1], ["mean"])
+    assert_same(ref, got)
+    assert abs(got.iloc[0, 1] - 2_000_000.0) < 1e-3
+
+
+def test_unique_names_no_silent_drop(ctx):
+    from cylon_tpu.data.column import Column
+
+    cols = [Column.from_numpy(np.arange(3), "a"),
+            Column.from_numpy(np.arange(3, 6), "a_2"),
+            Column.from_numpy(np.arange(6, 9), "a")]
+    from cylon_tpu.data.table import Table
+
+    t = Table(cols, ctx)
+    d = t.to_pydict()
+    assert len(d) == 3
+    assert list(d.keys()) == ["a", "a_2", "a_3"]
