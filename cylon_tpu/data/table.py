@@ -283,9 +283,12 @@ class Table:
     # ------------------------------------------------------------------
 
     def take(self, indices) -> "Table":
-        """Gather rows by index; −1 produces null rows."""
+        """Gather rows by LOGICAL index (live rows in order); −1 produces
+        null rows. Masked tables compact first so positional indexing
+        never addresses filtered-out rows."""
+        t = self.compact()
         idx = jnp.asarray(indices)
-        cols = [c.take(idx) for c in self._columns]
+        cols = [c.take(idx) for c in t._columns]
         return Table(cols, self._ctx)
 
     def project(self, columns: Sequence[Union[int, str]]) -> "Table":
@@ -307,12 +310,16 @@ class Table:
         return t.filter_mask(jnp.asarray(mask))
 
     def filter_mask(self, mask) -> "Table":
-        """Filter by a boolean mask array/column (vectorized path)."""
+        """Filter by a boolean mask array/column. ZERO host syncs: the
+        mask folds into ``row_mask`` (every kernel honors emit masks), so
+        a filter inside an eager pipeline costs one elementwise AND —
+        no count round-trip, no gather. Memory for the dead rows is
+        reclaimed at the next shuffle/compact (both drop masked rows)."""
         mask = jnp.asarray(mask)
         keep = mask & self.emit_mask()
-        total = int(keep.sum())
-        (idx,) = jnp.nonzero(keep, size=_pow2(total), fill_value=-1)
-        return self.take(idx[:total])
+        t = Table(list(self._columns), self._ctx, keep)
+        t._hash_partitioned = self._hash_partitioned
+        return t
 
     def slice(self, start: int, stop: int) -> "Table":
         t = self.compact()
@@ -362,7 +369,10 @@ class Table:
         """Local join; self is the LEFT table (pycylon table.pyx:373-390).
         algorithm: "auto" (default — fastest applicable path), "sort", or
         "hash" (reference join_config.hpp:25)."""
+        blk = kwargs.pop("probe_block_rows", None)
         cfg = self._make_join_config(table, join_type, algorithm, kwargs)
+        if blk:
+            return join_blocked(self, table, cfg, int(blk))
         return join(self, table, cfg)
 
     def distributed_join(self, table: "Table", join_type: str = "inner",
@@ -746,7 +756,32 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     scalars touch the host; the result keeps pow2 capacity with padding
     rows masked via row_mask. Varbytes key columns join on their
     content-hash identity; varbytes payload columns are re-gathered by
-    the materialized row indices (one varlen gather per column)."""
+    the materialized row indices (one varlen gather per column).
+
+    Working sets beyond HBM: when the estimated plan memory exceeds the
+    pool's headroom, the probe side is processed in blocks
+    (``join_blocked``); `Table.join(probe_block_rows=...)` forces it."""
+    est = _join_plan_bytes_estimate(left, right)
+    avail = left._ctx.memory_pool.available_bytes()
+    probe_cap = right.capacity if config.type == _join.JoinType.RIGHT \
+        else left.capacity
+    if avail and est > avail // 2 and probe_cap > (1 << 20):
+        blk = max((1 << 20),
+                  probe_cap // max(2 * est // max(avail, 1), 2))
+        return join_blocked(left, right, config, int(blk))
+    return _join_once(left, right, config)
+
+
+def _join_plan_bytes_estimate(left: Table, right: Table) -> int:
+    """Rough plan+materialize working-set bytes: sort operands + payload
+    gathers, ~6 u32-equivalents per row per column-ish."""
+    n = left.capacity + right.capacity
+    width = sum(max(np.dtype(c.data.dtype).itemsize, 4) + 1
+                for c in left._columns + right._columns)
+    return int(n) * (width + 24)
+
+
+def _join_once(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     lcols, rcols = align_key_columns(left, right, config.left_column_idx,
                                      config.right_column_idx)
     # varbytes alignment may have lifted a dictionary key column: joins
@@ -845,6 +880,87 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
                               cols[nl + j].validity, None, cols[nl + j].name,
                               varbytes=vb)
     return Table(cols, left._ctx, emit)
+
+
+def join_blocked(left: Table, right: Table, config: _join.JoinConfig,
+                 probe_block_rows: int) -> Table:
+    """Chunked local join for working sets beyond HBM (SURVEY §5.7; the
+    reference's analog is incremental buffer-at-a-time serialization,
+    arrow_all_to_all.cpp:83-135): the PROBE side (left; right for RIGHT
+    joins) is processed in row blocks of ``probe_block_rows``, each block
+    joined against the resident build side at bounded capacity, results
+    concatenated. Peak device memory ≈ build side + one block's join,
+    instead of the full probe×build plan.
+
+    FULL_OUTER runs blocked LEFT plus ONE key-membership pass that
+    appends build rows whose key matches no probe row (keys-only memory,
+    no payload blowup)."""
+    jt = config.type
+    if jt == _join.JoinType.RIGHT:
+        probe, other = right, left
+    else:
+        probe, other = left, right
+    n = probe.capacity
+    blocks = []
+    sub_type = _join.JoinType.LEFT if jt == _join.JoinType.FULL_OUTER \
+        else jt
+    for lo in range(0, max(n, 1), probe_block_rows):
+        blk = probe.slice(lo, min(lo + probe_block_rows, n)) \
+            if probe.row_mask is None else Table(
+                [c.slice(lo, min(lo + probe_block_rows, n))
+                 for c in probe._columns], probe._ctx,
+                probe.row_mask[lo:min(lo + probe_block_rows, n)])
+        if jt == _join.JoinType.RIGHT:
+            blocks.append(_join_once(other, blk, config))
+        else:
+            cfg = _join.JoinConfig(sub_type, config.left_column_idx,
+                                   config.right_column_idx,
+                                   config.algorithm)
+            blocks.append(_join_once(blk, other, cfg))
+    out = concat_tables(blocks, left._ctx) if len(blocks) > 1 \
+        else blocks[0]
+    if jt != _join.JoinType.FULL_OUTER:
+        return out
+
+    # FULL_OUTER: append unmatched build (right) rows via one keys-only
+    # membership pass (FULL_OUTER = LEFT output + right rows whose key
+    # matches no left row; null keys never match)
+    lcols, rcols = align_key_columns(left, right, config.left_column_idx,
+                                     config.right_column_idx)
+    lkeys, _lv_, _f = _expanded_keys(lcols)
+    rkeys, _rv_, _f2 = _expanded_keys(rcols)
+    lv = _all_valid(lcols) & left.emit_mask()
+    rv = _all_valid(rcols) & right.emit_mask()
+    gl, gr = _order.dense_ranks_two(
+        [jnp.where(lv, jnp.asarray(k), jnp.asarray(k).dtype.type(0))
+         for k in lkeys],
+        [jnp.where(rv, jnp.asarray(k), jnp.asarray(k).dtype.type(0))
+         for k in rkeys])
+    from ..ops.setops import _isin
+
+    in_l = _isin(jnp.where(rv, gr, -2), jnp.where(lv, gl, -1), None)
+    un = right.emit_mask() & jnp.where(rv, ~in_l, True)
+    r_unmatched = right.filter_mask(un)
+
+    def _null_col(c: Column, n: int) -> Column:
+        if c.is_varbytes:
+            from .strings import VarBytes
+
+            z = jnp.zeros(n, jnp.int32)
+            return Column.from_varbytes(
+                VarBytes(jnp.zeros(1, jnp.uint32), z, z, 1, 0),
+                jnp.zeros(n, bool), c.name, c.dtype)
+        return Column(jnp.zeros(n, c.data.dtype), c.dtype,
+                      jnp.zeros(n, bool), c.dictionary, c.name)
+
+    ncap = r_unmatched.capacity
+    tail = Table([_null_col(c, ncap) for c in left._columns]
+                 + list(r_unmatched._columns), left._ctx,
+                 r_unmatched.emit_mask())
+    tail = Table([c.rename(nm) for c, nm in
+                  zip(tail._columns, out.column_names)], left._ctx,
+                 tail.row_mask)
+    return concat_tables([out, tail], left._ctx)
 
 
 def _aligned_setop_columns(left: Table, right: Table):

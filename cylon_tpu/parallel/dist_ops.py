@@ -946,6 +946,22 @@ def distributed_join_ring(left: Table, right: Table,
     pairs, extra = counts[:, :world], counts[:, world]
     cap_step = _capacity(int(pairs.max())) if pairs.size else 1
     cap_extra = _capacity(int(extra.max())) if emit_un_a else 0
+    # skew guard: the output slab is world*cap_step rows per shard, with
+    # cap_step set by the WORST (shard, step) block — a hot key inflates
+    # every shard's slab. When the slab overshoots the actual worst
+    # per-shard output by more than RING_SKEW_FACTOR (or blows the HBM
+    # budget), the shuffle join's blockwise machinery degrades more
+    # gracefully — route there.
+    worst_total = int(pairs.sum(axis=1).max()) if pairs.size else 0
+    slab = world * cap_step
+    budget = ctx.memory_pool.comm_budget_bytes()
+    row_bytes = sum(
+        int(np.dtype(c.data.dtype).itemsize) + 1
+        for c in a_t._columns + b_t._columns)
+    over_budget = bool(budget) and slab * row_bytes > budget
+    if slab > RING_SKEW_FACTOR * _capacity(max(worst_total, 1)) \
+            or over_budget:
+        return distributed_join(left, right, config)
 
     with _phase("ring_join.materialize", seq):
         sa, sav, sb, sbv, emit = _ring_mat_fn(
@@ -1239,26 +1255,114 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
 
 
 # ---------------------------------------------------------------------------
-# distributed sort (reference has local Sort only, table.hpp:365; here a
-# GLOBAL sort over the sharded arrays — XLA lowers the cross-shard sort/
-# gather itself. Stays on device: dead rows sort to the tail via an emit
-# key instead of host-side compaction.)
+# distributed sort. The reference has local Sort only (table.hpp:365);
+# this extension is splitter-based: sample keys → agree global range
+# splitters → range-partition through the SAME exchange the joins use →
+# fused per-shard sort. Nothing ever all-gathers; shard i's rows all
+# precede shard i+1's, so global order = (shard, position). Multi-key
+# and varbytes ORDER columns use the XLA global-sort fallback /
+# local-sort path.
 # ---------------------------------------------------------------------------
+
+# per-shard sample count for splitter estimation (total = world * this)
+SORT_SAMPLES_PER_SHARD = 4096
+
+# ring join routes to the shuffle join when its output slab overshoots
+# the worst per-shard output by this factor (hot-key skew)
+RING_SKEW_FACTOR = 4
+
+
+@lru_cache(maxsize=None)
+def _shard_sort_fn(mesh, nd: int, nv: int):
+    """Per-shard fused sort by (dead-last, key bits): every payload
+    column rides as a sort operand; returns sorted dat/val/emit plus the
+    permutation (for varbytes content takes)."""
+    spec = P(mesh.axis_names[0])
+
+    def kernel(bits, emit, dat, val):
+        n = bits.shape[0]
+        dead = (~emit).astype(jnp.uint8)
+        iota = jnp.arange(n, dtype=jnp.int32)
+        ops = (dead, bits) + tuple(dat) + tuple(val) + (emit, iota)
+        res = jax.lax.sort(ops, num_keys=2, is_stable=True)
+        return (res[2:2 + nd], res[2 + nd:2 + nd + nv], res[-2], res[-1])
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 4,
+                             out_specs=spec))
+
+
+def _range_splitters(ctx: CylonContext, bits, emit):
+    """Host-side splitter agreement: gather a small random key sample,
+    keep live rows, take world-1 quantiles. Deterministic seed keeps
+    every controller process agreeing (multi-host: same computation on
+    the replicated sample)."""
+    world = ctx.get_world_size()
+    n = int(bits.shape[0])
+    rng = np.random.default_rng(0xC11)
+    k = min(n, SORT_SAMPLES_PER_SHARD * world)
+    pos = jnp.asarray(np.sort(rng.integers(0, n, k)).astype(np.int32))
+    sample = np.asarray(jax.device_get(jnp.take(bits, pos)))
+    live = np.asarray(jax.device_get(jnp.take(emit, pos)))
+    sample = np.sort(sample[live])
+    if sample.size == 0:
+        return np.zeros(world - 1, dtype=np.asarray(
+            jax.device_get(bits[:1])).dtype)
+    q = (np.arange(1, world) * sample.size) // world
+    return sample[q]
+
 
 def distributed_sort(table: Table, order_by, ascending=True) -> Table:
     ctx = table._ctx
-    if any(c.is_varbytes for c in table._columns):
-        raise CylonError(
-            Code.NotImplemented,
-            "distributed_sort on varbytes columns needs the cross-shard "
-            "varlen gather; dictionary-encode, or sort locally per shard")
     t = shard.distribute(table, ctx) if ctx.is_distributed() else table
     by = order_by if isinstance(order_by, (list, tuple)) else [order_by]
     idxs = [t._col_index(c) for c in by]
     asc = list(ascending) if isinstance(ascending, (list, tuple)) \
         else [ascending] * len(idxs)
+    world = ctx.get_world_size()
+    order_cols = [t._columns[i] for i in idxs]
+
+    splitter_ok = (ctx.is_distributed() and world > 1
+                   and len(idxs) == 1 and not order_cols[0].is_varbytes)
+    if not splitter_ok:
+        return _global_sort_fallback(ctx, t, idxs, asc, order_cols)
+
+    seq = ctx.get_next_sequence()
+    with _phase("distributed_sort.partition", seq):
+        bits = shard.pin(_order.sort_keys(order_cols, asc)[0], ctx)
+        emit = shard.pin(t.emit_mask(), ctx)
+        splitters = _range_splitters(ctx, bits, emit)
+        # target = #splitters <= key: W-1 vector compares, no search
+        targets = jnp.zeros(bits.shape[0], jnp.int32)
+        for s in splitters:
+            targets = targets + (bits >= jnp.asarray(s)).astype(jnp.int32)
+        cols_s, emit_s, xout = _exchange_table(
+            t, shard.pin(targets, ctx), emit, ctx, {"sb": bits})
+
+    with _phase("distributed_sort.local", seq):
+        dat = tuple(shard.pin(c.data, ctx) for c in cols_s)
+        val = tuple(shard.pin(c.valid_mask(), ctx) for c in cols_s)
+        sdat, sval, semit, perm = _shard_sort_fn(
+            ctx.mesh, len(dat), len(val))(xout["sb"], emit_s, dat, val)
+    out_cols = []
+    for d, v, c in zip(sdat, sval, cols_s):
+        if c.is_varbytes:
+            vb = _varlen_take_sharded(ctx, c.varbytes, perm)
+            out_cols.append(Column(vb.lengths, c.dtype, v, None, c.name,
+                                   varbytes=vb))
+        else:
+            out_cols.append(Column(d, c.dtype, v, c.dictionary, c.name))
+    return Table(out_cols, ctx, semit)
+
+
+def _global_sort_fallback(ctx, t, idxs, asc, order_cols):
+    """XLA global sort (multi-key / varbytes order columns / local)."""
+    if any(c.is_varbytes for c in order_cols):
+        raise CylonError(
+            Code.NotImplemented,
+            "distributed_sort on a varbytes ORDER column needs device "
+            "prefix-key splitters; dictionary-encode the sort column")
     with _phase("distributed_sort", ctx.get_next_sequence()):
-        keys = _order.sort_keys([t._columns[i] for i in idxs], asc)
+        keys = _order.sort_keys(order_cols, asc)
         emit = t.emit_mask()
         # live rows first, padding at the tail
         dead_last = (~emit).astype(jnp.uint8)
@@ -1266,6 +1370,11 @@ def distributed_sort(table: Table, order_by, ascending=True) -> Table:
         cols = []
         for c in t._columns:
             g = c.take(perm)
+            if g.is_varbytes:
+                # eager varlen gather produced an unsharded layout; keep
+                # it intact (content lives in g.varbytes, not g.data)
+                cols.append(g)
+                continue
             validity = None if g.validity is None \
                 else shard.pin(g.validity, ctx)
             cols.append(Column(shard.pin(g.data, ctx), g.dtype, validity,

@@ -325,3 +325,51 @@ def test_dist_join_correct_under_hot_key(dist_ctx8):
         ct.Table.from_pydict(la, {"k": kb, "w": np.zeros(n)}), "inner",
         on="k")
     assert j.row_count == lj.row_count
+
+
+def test_splitter_distributed_sort(dist_ctx8):
+    """Splitter-based range-partition sort: global order across shards,
+    no all-gather, nulls last, payload (incl. varbytes) rides along."""
+    from cylon_tpu.data import strings as _strings
+
+    rng = np.random.default_rng(21)
+    n = 30_000
+    k = rng.integers(-1_000_000, 1_000_000, n).astype(np.int32)
+    v = rng.normal(size=n)
+    import pandas as pd
+
+    sv = np.array(["s%06d" % i for i in rng.integers(0, n, n)], dtype=object)
+    old = _strings.DICT_MAX_VOCAB
+    try:
+        _strings.DICT_MAX_VOCAB = 16  # payload column -> varbytes
+        t = ct.Table.from_pandas(dist_ctx8, pd.DataFrame(
+            {"k": k, "v": v, "s": sv}))
+        assert t.get_column(2).is_varbytes
+        s = ct.distributed_sort(t, "k")
+    finally:
+        _strings.DICT_MAX_VOCAB = old
+    df = s.to_pandas()
+    order = np.argsort(k, kind="stable")
+    np.testing.assert_array_equal(df["k"].to_numpy(), k[order])
+    np.testing.assert_allclose(df["v"].to_numpy(), v[order])
+    # varbytes payload rows stayed attached to their keys
+    assert list(df["s"]) == list(sv[order])
+    # descending
+    s2 = ct.distributed_sort(t, "k", ascending=False)
+    np.testing.assert_array_equal(
+        s2.to_pandas()["k"].to_numpy(), k[order[::-1]])
+
+
+def test_splitter_sort_with_nulls_and_skew(dist_ctx8):
+    import pandas as pd
+
+    rng = np.random.default_rng(22)
+    n = 12_000
+    k = rng.normal(size=n).astype(np.float32)
+    k[rng.random(n) < 0.1] = np.nan     # nulls last
+    k[rng.random(n) < 0.4] = 7.25       # heavy tie skew
+    t = ct.Table.from_pandas(dist_ctx8, pd.DataFrame({"k": k}))
+    s = ct.distributed_sort(t, "k")
+    got = s.to_pandas()["k"].to_numpy()
+    exp = np.sort(k)  # numpy sorts NaN last
+    np.testing.assert_allclose(got, exp)

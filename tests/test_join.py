@@ -144,3 +144,46 @@ def test_filter_on_padded_join_result(local_ctx):
     f = j[j["rt-3"] > 4]
     assert f.row_count == 2
     assert sorted(f.to_pydict()["rt-3"].tolist()) == [5, 6]
+
+
+@pytest.mark.parametrize("jt", ["inner", "left", "right", "outer"])
+def test_blocked_join_matches_unblocked(local_ctx, jt):
+    """Chunked probe-side join (the >HBM path, SURVEY §5.7) must equal
+    the one-shot join for every type, including FULL_OUTER's
+    unmatched-build membership pass."""
+    rng = np.random.default_rng(51)
+    n = 5000
+    ldf = {"k": rng.integers(0, 800, n).astype(np.int64),
+           "v": rng.integers(0, 100, n).astype(np.int32)}
+    rdf = {"k": rng.integers(0, 800, 3000).astype(np.int64),
+           "w": rng.integers(0, 100, 3000).astype(np.int32)}
+    left = ct.Table.from_pydict(local_ctx, ldf)
+    right = ct.Table.from_pydict(local_ctx, rdf)
+    ref = left.join(right, jt, "sort", on=["k"]).to_pandas()
+    got = left.join(right, jt, "sort", on=["k"],
+                    probe_block_rows=700).to_pandas()
+    assert got.shape[0] == ref.shape[0]
+    key = lambda df: sorted(map(tuple, df.fillna(-9).itertuples(index=False)))
+    assert key(got) == key(ref)
+
+
+def test_blocked_join_with_nulls_and_strings(local_ctx):
+    import pandas as pd
+
+    rng = np.random.default_rng(52)
+    n = 2000
+    keys = np.array([f"id{i:04d}" for i in range(300)], dtype=object)
+    lk = keys[rng.integers(0, 300, n)].astype(object)
+    lk[rng.random(n) < 0.05] = None
+    rk = keys[rng.integers(0, 300, 900)]
+    left = ct.Table.from_pandas(local_ctx, pd.DataFrame(
+        {"k": lk, "v": np.arange(n)}))
+    right = ct.Table.from_pandas(local_ctx, pd.DataFrame(
+        {"k": rk, "w": np.arange(900)}))
+    ref = left.join(right, "outer", "sort", on=["k"]).to_pandas()
+    got = left.join(right, "outer", "sort", on=["k"],
+                    probe_block_rows=512).to_pandas()
+    assert got.shape[0] == ref.shape[0]
+    key = lambda df: sorted(map(
+        tuple, df.fillna(-9).astype(str).itertuples(index=False)))
+    assert key(got) == key(ref)

@@ -73,3 +73,51 @@ def test_ring_outer_falls_back(dctx):
     ref = left.distributed_join(right, "outer", on="k")
     got = left.distributed_join(right, "outer", on="k", comm="ring")
     assert _rows(got) == _rows(ref)
+
+
+def test_ring_join_hot_key_routes_to_shuffle(dist_ctx8):
+    """Pathological skew (one key = 50% of rows): the ring's slab
+    heuristic must route to the shuffle join and stay correct."""
+    rng = np.random.default_rng(31)
+    n = 4000
+    ka = np.where(rng.random(n) < 0.5, 0, rng.integers(1, 100_000, n))
+    kb = np.where(rng.random(n) < 0.02, 0, rng.integers(1, 100_000, n))
+    a = ct.Table.from_pydict(dist_ctx8, {"k": ka.astype(np.int64),
+                                         "v": np.arange(n)})
+    b = ct.Table.from_pydict(dist_ctx8, {"k": kb.astype(np.int64),
+                                         "w": np.arange(n)})
+    j = a.distributed_join(b, "inner", on="k", comm="ring")
+    import pandas as pd
+    exp = pd.DataFrame({"k": ka, "v": np.arange(n)}).merge(
+        pd.DataFrame({"k": kb, "w": np.arange(n)}), on="k")
+    assert j.row_count == exp.shape[0]
+    got = j.to_pandas()
+    assert sorted(zip(got["lt-0"], got["lt-1"], got["rt-3"])) == \
+        sorted(zip(exp["k"], exp["v"], exp["w"]))
+
+
+def test_ring_join_uniform_stays_on_ring(dist_ctx8, monkeypatch):
+    """Uniform keys must NOT trigger the skew fallback (the heuristic
+    would otherwise silently disable the ring path)."""
+    from cylon_tpu.parallel import dist_ops as _do
+
+    called = {}
+    orig = _do.distributed_join
+
+    def spy(*a, **k):
+        called["fell_back"] = True
+        return orig(*a, **k)
+
+    monkeypatch.setattr(_do, "distributed_join", spy)
+    rng = np.random.default_rng(32)
+    n = 4000
+    a = ct.Table.from_pydict(dist_ctx8, {
+        "k": rng.integers(0, 100_000, n).astype(np.int64),
+        "v": np.arange(n)})
+    b = ct.Table.from_pydict(dist_ctx8, {
+        "k": rng.integers(0, 100_000, n).astype(np.int64),
+        "w": np.arange(n)})
+    j = _do.distributed_join_ring(a, b, a._make_join_config(
+        b, "inner", "sort", {"on": ["k"]}))
+    assert "fell_back" not in called
+    assert j.row_count > 0

@@ -132,3 +132,31 @@ def test_bad_column_raises(local_ctx):
     with pytest.raises(ct.CylonError) as e:
         t.project(["nope"])
     assert e.value.code == ct.Code.KeyError
+
+
+def test_take_after_filter_is_logical(local_ctx):
+    """filter_mask is mask-based (no compaction); take must index LIVE
+    rows, never resurrect filtered ones."""
+    t = ct.Table.from_pydict(local_ctx, {"k": np.array([10, 20, 30, 40])})
+    f = t.filter_mask(np.array([False, True, False, True]))
+    got = f.take(np.array([0, 1], np.int32)).to_pydict()["k"]
+    assert list(got) == [20, 40]
+
+
+def test_global_sort_fallback_varbytes_payload(local_ctx):
+    """Multi-key distributed_sort fallback must carry varbytes payload
+    content, not its byte lengths."""
+    from cylon_tpu.data import strings as _strings
+
+    old = _strings.DICT_MAX_VOCAB
+    try:
+        _strings.DICT_MAX_VOCAB = 2
+        t = ct.Table.from_pydict(local_ctx, {
+            "k": np.array([3, 1, 2], np.int64),
+            "k2": np.array([0, 0, 0], np.int64),
+            "s": np.array(["ccc", "a", "bb"], dtype=object)})
+        assert t.get_column(2).is_varbytes
+        s = ct.distributed_sort(t, ["k", "k2"])
+    finally:
+        _strings.DICT_MAX_VOCAB = old
+    assert list(s.to_pydict()["s"]) == ["a", "bb", "ccc"]
