@@ -774,11 +774,15 @@ def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
 
 def _join_plan_bytes_estimate(left: Table, right: Table) -> int:
     """Rough plan+materialize working-set bytes: sort operands + payload
-    gathers, ~6 u32-equivalents per row per column-ish."""
+    gathers, ~6 u32-equivalents per row per column-ish; varbytes columns
+    add their word-buffer bytes (the content dominates large strings)."""
     n = left.capacity + right.capacity
     width = sum(max(np.dtype(c.data.dtype).itemsize, 4) + 1
                 for c in left._columns + right._columns)
-    return int(n) * (width + 24)
+    vb_bytes = sum(4 * int(c.varbytes.words.shape[0])
+                   for c in left._columns + right._columns
+                   if c.is_varbytes)
+    return int(n) * (width + 24) + 2 * vb_bytes
 
 
 def _join_once(left: Table, right: Table, config: _join.JoinConfig) -> Table:
@@ -803,9 +807,22 @@ def _join_once(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     # route: the sort-stream path handles single 4-byte keys; the
     # hash-stream path (JoinAlgorithm.HASH — reference hash join,
     # arrow_hash_kernels.hpp:48-225) covers multi-column/wide keys by
-    # sorting a 2x32-bit row hash with exact collision fallback; the XLA
-    # plan is the general fallback (FULL_OUTER, forced, collisions).
+    # sorting a 2x32-bit row hash with exact collision fallback.
+    # FULL_OUTER streams as LEFT + one unmatched-build membership tail
+    # (_append_unmatched_right); the XLA plan remains the general
+    # fallback (forced algorithms, collisions, non-streamable shapes).
     alg = config.algorithm
+    if config.type == _join.JoinType.FULL_OUTER and \
+            (_join.stream_plan_applicable(lkeys, rkeys, str_flags,
+                                          _join.JoinType.LEFT)
+             or _join.hash_stream_applicable(lkeys, rkeys, str_flags,
+                                             _join.JoinType.LEFT)):
+        sub = _join.JoinConfig(_join.JoinType.LEFT,
+                               config.left_column_idx,
+                               config.right_column_idx, alg)
+        out = _join_once(left, right, sub)
+        return _append_unmatched_right(left, right, config, out,
+                                       aligned=(lcols, rcols))
     use_stream = (alg != _join.JoinAlgorithm.HASH
                   and _join.stream_plan_applicable(lkeys, rkeys, str_flags,
                                                    config.type))
@@ -921,12 +938,20 @@ def join_blocked(left: Table, right: Table, config: _join.JoinConfig,
         else blocks[0]
     if jt != _join.JoinType.FULL_OUTER:
         return out
+    return _append_unmatched_right(left, right, config, out)
 
-    # FULL_OUTER: append unmatched build (right) rows via one keys-only
-    # membership pass (FULL_OUTER = LEFT output + right rows whose key
-    # matches no left row; null keys never match)
-    lcols, rcols = align_key_columns(left, right, config.left_column_idx,
-                                     config.right_column_idx)
+
+def _append_unmatched_right(left: Table, right: Table,
+                            config: _join.JoinConfig, out: Table,
+                            aligned=None) -> Table:
+    """FULL_OUTER = LEFT output + right rows whose key matches no left
+    row (null keys never match): ONE keys-only membership pass appends
+    the unmatched build rows — how both the blocked join and the
+    streaming path lift their LEFT machinery to FULL_OUTER.
+    ``aligned``: (lcols, rcols) already aligned by the caller (skips a
+    repeat dictionary-unification / content-hash pass)."""
+    lcols, rcols = aligned if aligned is not None else align_key_columns(
+        left, right, config.left_column_idx, config.right_column_idx)
     lkeys, _lv_, _f = _expanded_keys(lcols)
     rkeys, _rv_, _f2 = _expanded_keys(rcols)
     lv = _all_valid(lcols) & left.emit_mask()
@@ -940,7 +965,10 @@ def join_blocked(left: Table, right: Table, config: _join.JoinConfig,
 
     in_l = _isin(jnp.where(rv, gr, -2), jnp.where(lv, gl, -1), None)
     un = right.emit_mask() & jnp.where(rv, ~in_l, True)
-    r_unmatched = right.filter_mask(un)
+    # compact: the tail must carry only the unmatched rows (filter_mask
+    # is a mask view, and the >HBM blocked path relies on the tail NOT
+    # being build-side-capacity wide)
+    r_unmatched = right.filter_mask(un).compact()
 
     def _null_col(c: Column, n: int) -> Column:
         if c.is_varbytes:

@@ -959,8 +959,12 @@ def distributed_join_ring(left: Table, right: Table,
         int(np.dtype(c.data.dtype).itemsize) + 1
         for c in a_t._columns + b_t._columns)
     over_budget = bool(budget) and slab * row_bytes > budget
-    if slab > RING_SKEW_FACTOR * _capacity(max(worst_total, 1)) \
-            or over_budget:
+    # absolute floor: tiny slabs are free regardless of ratio — without
+    # it, sparse-output joins (cap_step ~ a few rows) would always
+    # misroute off the ring
+    skewed = slab > (1 << 16) and \
+        slab > RING_SKEW_FACTOR * _capacity(max(worst_total, 1))
+    if skewed or over_budget:
         return distributed_join(left, right, config)
 
     with _phase("ring_join.materialize", seq):

@@ -142,3 +142,35 @@ def test_stream_skips_unsupported(ctx):
         _join.STREAM_PLAN = old
     assert outer.row_count >= 100
     assert multi.row_count > 0
+
+
+@pytest.mark.parametrize("nl,nr,hi", [(400, 500, 40), (600, 80, 2000)])
+def test_stream_full_outer(ctx, nl, nr, hi):
+    """FULL_OUTER now streams as LEFT + one unmatched-build membership
+    tail; must match the XLA plan's native FULL_OUTER."""
+    rng = np.random.default_rng(nl + nr)
+    left = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, hi, nl).astype(np.int32),
+        "v": rng.integers(0, 99, nl).astype(np.int32)})
+    right = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, hi, nr).astype(np.int32),
+        "w": rng.integers(0, 99, nr).astype(np.int32)})
+    ref, got = _join_both(left, right, "outer", on=["k"])
+    assert got.row_count == ref.row_count
+    assert _rows(got) == _rows(ref)
+
+
+def test_stream_full_outer_multikey_hash(ctx):
+    rng = np.random.default_rng(9)
+    nl, nr = 350, 270
+    left = ct.Table.from_pydict(ctx, {
+        "a": rng.integers(0, 12, nl).astype(np.int64),
+        "b": rng.integers(0, 5, nl).astype(np.int32),
+        "v": rng.integers(0, 99, nl).astype(np.int32)})
+    right = ct.Table.from_pydict(ctx, {
+        "a": rng.integers(0, 12, nr).astype(np.int64),
+        "b": rng.integers(0, 5, nr).astype(np.int32),
+        "w": rng.integers(0, 99, nr).astype(np.int32)})
+    ref, got = _join_both(left, right, "outer", on=["a", "b"])
+    assert got.row_count == ref.row_count
+    assert _rows(got) == _rows(ref)
