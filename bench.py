@@ -35,12 +35,24 @@ _BASELINE_ROWS_PER_S = 200e6 / 141.5
 
 def _sync(t):
     """Force execution (block_until_ready is a no-op on axon): fetch one
-    element of the output column and the row mask."""
+    element of every column's terminal buffers and the row mask —
+    varbytes columns must force their WORD buffer (the lane-interleave
+    is a separate chained program from the lengths)."""
     import jax
 
-    jax.device_get(t.get_column(0).data[:1])
+    import jax.numpy as jnp
+
+    # ONE probe scalar + ONE device_get: every terminal buffer feeds the
+    # probe, so one host round trip (~100 ms through the axon tunnel)
+    # forces the whole result instead of one trip per column
+    probe = jnp.float32(0)
+    for c in t._columns:
+        probe = probe + c.data[:1].astype(jnp.float32)[0]
+        if c.is_varbytes:
+            probe = probe + c.varbytes.words[:1].astype(jnp.float32)[0]
     if t.row_mask is not None:
-        jax.device_get(t.row_mask[:1])
+        probe = probe + t.row_mask[:1].astype(jnp.float32)[0]
+    jax.device_get(probe)
 
 
 def _time(fn, iters):

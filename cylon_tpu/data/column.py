@@ -75,6 +75,13 @@ class Column:
         safe = np.array([v if ok else filler for v, ok in zip(obj, validity)],
                         dtype=object)
         n = len(obj)
+        if any(isinstance(v, bytes) for v in safe):
+            # BINARY values: straight to varbytes (no sorted-str vocab —
+            # a str() decode would corrupt non-UTF-8 payloads)
+            vb = VarBytes.from_host(safe)
+            return Column.from_varbytes(
+                vb, _dev_mask(validity if not validity.all() else None),
+                name, dtypes.Binary())
         thresh = min(DICT_MAX_VOCAB, max(16, int(n * DICT_MAX_RATIO)))
         # chunked distinct probe with early bail: the varbytes branch
         # (exactly the high-cardinality case) must not pay np.unique's
@@ -325,14 +332,30 @@ def align_string_columns(a: Column, b: Column) -> Tuple[Column, Column]:
     return unify_dictionaries(a, b)
 
 
-def string_key_arrays(col: Column):
-    """Device key arrays standing in for one string key column: varbytes
-    → (h1, h2, h3, len) content-hash identity; dictionary → the (already
-    rank-preserving) codes. Returns (keys, valids, flags) triples ready
-    to extend a join/groupby key list."""
+def string_key_arrays(col: Column, k_words: int = None):
+    """Device key arrays standing in for one string key column.
+
+    varbytes, short (≤ EXACT_KEY_WORDS words): the raw prefix word lanes
+    + byte length — byte-EXACT equality, matching the reference's
+    guarantee (join/join.cpp:648-799) with zero hashing. ``k_words``
+    forces the lane count so two joined columns emit aligned lanes
+    (pass max of both sides' max_words).
+
+    varbytes, long: (h1, h2, h3, len) 96-bit content-hash identity.
+    dictionary: the (already rank-preserving) codes.
+    Returns (keys, valids, flags) triples ready to extend a
+    join/groupby key list."""
+    from .strings import EXACT_KEY_WORDS
+
     if col.is_varbytes:
-        ks = col.varbytes.hash_keys()
-        return (list(ks), [col.validity] + [None] * (len(ks) - 1),
+        vb = col.varbytes
+        k = vb.max_words if k_words is None else max(int(k_words),
+                                                     vb.max_words)
+        if k <= EXACT_KEY_WORDS:
+            ks = vb.word_lanes(k) + [vb.lengths.astype(jnp.uint32)]
+        else:
+            ks = list(vb.hash_keys())
+        return (ks, [col.validity] + [None] * (len(ks) - 1),
                 [False] * len(ks))
     return [col.data], [col.validity], [True]
 

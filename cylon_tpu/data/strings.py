@@ -53,6 +53,39 @@ DICT_MAX_RATIO = 0.5
 # (4 bytes each); longer rows fall back to a host sort
 SORT_PREFIX_WORDS = 16
 
+# Word-lane fast paths (round 4). Short rows are just structs of a few
+# u32 words, and TPU treats them best that way:
+# * EXACT_KEY_WORDS: rows up to this many words join/group/set-op on
+#   their RAW prefix words (+ byte length) as key lanes — byte-exact
+#   equality (the reference's guarantee, join/join.cpp:648-799) with
+#   ZERO hashing and zero varlen gathers; the lanes ride fused sorts as
+#   operands. Longer rows keep the 96-bit content-hash identity.
+# * LANE_WORDS_MAX: varlen takes (join/sort/filter outputs) for rows up
+#   to this many words run as fixed-width lane gathers producing a
+#   STRIDED layout (starts[r] = r*K) — no word→row map, no scatter, no
+#   host sync. XLA's per-element gather costs ~15-30 ns on TPU, so the
+#   packed-layout take of an M-row output costs ~3 passes over
+#   cap_w≈M*avg_words elements (measured 4.6 s at M=16.8M, 3 words);
+#   the lane route costs K gathers of M rows and nothing else.
+# A strided layout is a VALID VarBytes everywhere: every kernel here
+# reads rows via (starts, lengths) ranges and the prefix-sum hash
+# differences cancel gap contributions, so only tightness of memory
+# distinguishes it from the packed layout (waste ≤ K/avg_words, bounded
+# by LANE_WORDS_MAX).
+EXACT_KEY_WORDS = 5
+LANE_WORDS_MAX = 8
+
+
+def pair_k_words(a, b):
+    """Shared lane count for two columns joined/compared as a key pair,
+    or None when lane pairing does not apply. LOAD-BEARING: both sides
+    of a key comparison must emit the same number of word lanes or the
+    key arrays zip misaligned — every two-table key-building site must
+    route through this."""
+    if getattr(a, "is_varbytes", False) and getattr(b, "is_varbytes", False):
+        return max(a.varbytes.max_words, b.varbytes.max_words)
+    return None
+
 # hash schemes: (g multiplier, seed, post-mix selector). g odd so g^p
 # never collapses mod 2^32; three independent schemes give 96 id bits.
 _G1, _G2, _G3 = np.uint32(31), np.uint32(0x01000193), np.uint32(0x9E3779B1)
@@ -81,14 +114,18 @@ class VarBytes:
     """
 
     def __init__(self, words, starts, lengths, max_words: int,
-                 total_words: int, shard_geom=None):
+                 total_words: int, shard_geom=None, stride=None):
         self.words = words
         self.starts = starts
         self.lengths = lengths
         self.max_words = max(int(max_words), 1)
         self.total_words = int(total_words)
         self.shard_geom = shard_geom
+        # stride: None = packed; int K = strided layout starts[r] = r*K
+        # (word_lanes become reshape slices instead of gathers)
+        self.stride = stride
         self._hash_cache = None  # buffers are immutable; memoize hashes
+        self._lane_cache = {}    # k_lim -> word lanes (immutable buffers)
 
     def __len__(self) -> int:
         return int(self.lengths.shape[0])
@@ -212,15 +249,75 @@ class VarBytes:
             ln = jnp.where(validity, ln, jnp.uint32(0))
         return h1, h2, h3, ln
 
+    def word_lanes(self, k_lim: Optional[int] = None) -> list:
+        """Rows as ``k_lim`` fixed u32 lane arrays: lane k holds each
+        row's word k, zero past the row's last word (matching the
+        tail-zero storage invariant, so lane-tuple equality + the length
+        lane IS byte equality). Strided layouts slice their word buffer;
+        packed layouts gather once per lane (memoized)."""
+        k_lim = int(self.max_words if k_lim is None else k_lim)
+        cached = self._lane_cache.get(k_lim)
+        if cached is not None:
+            return list(cached)
+        n = self.nrows
+        nw = _nwords(self.lengths)
+        if (self.stride is not None and self.shard_geom is None
+                and int(self.words.shape[0]) >= n * self.stride):
+            grid = self.words[:n * self.stride].reshape(n, self.stride)
+            lanes = [jnp.where(k < nw, grid[:, k], jnp.uint32(0))
+                     if k < self.stride else jnp.zeros(n, jnp.uint32)
+                     for k in range(k_lim)]
+        else:
+            wcap = int(self.words.shape[0])
+            estarts = self.eff_starts()
+            lanes = []
+            for k in range(k_lim):
+                pos = jnp.clip(estarts + k, 0, wcap - 1)
+                lanes.append(jnp.where(k < nw, jnp.take(self.words, pos),
+                                       jnp.uint32(0)))
+        self._lane_cache[k_lim] = tuple(lanes)
+        return lanes
+
+    @staticmethod
+    def from_lanes(lanes: Sequence[jnp.ndarray], lengths,
+                   shard_geom=None) -> "VarBytes":
+        """Build a STRIDED VarBytes from word lanes + byte lengths (the
+        join/take output path — words beyond each row's length are
+        zeroed so the gap-zero invariant holds)."""
+        K = max(len(lanes), 1)
+        n = int(lengths.shape[0])
+        nw = _nwords(lengths)
+        masked = [jnp.where(k < nw, l, jnp.uint32(0))
+                  for k, l in enumerate(lanes)] or \
+            [jnp.zeros(n, jnp.uint32)]
+        flat = jnp.stack(masked, axis=1).reshape(-1)
+        cap = _capacity(max(n * K, 1))
+        if cap > n * K:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros(cap - n * K, jnp.uint32)])
+        starts = jnp.arange(n, dtype=jnp.int32) * jnp.int32(K)
+        vb = VarBytes(flat, starts, lengths, K, n * K,
+                      shard_geom=shard_geom, stride=K)
+        vb._lane_cache[K] = tuple(masked)
+        return vb
+
     def take(self, indices) -> "VarBytes":
         """Varlen row gather; negative indices produce empty rows (the
         −1→null discipline — validity is the owning Column's job).
-        Eager: one scalar host sync picks the output word capacity."""
+        Short rows (≤ LANE_WORDS_MAX words) gather as fixed lanes into a
+        strided layout — no word→row map, no host sync; longer rows use
+        the packed-layout program with one capacity sync."""
         idx = jnp.asarray(indices)
         if self.nrows == 0 or idx.shape[0] == 0:
             z = jnp.zeros(idx.shape[0], jnp.int32)
             return VarBytes(jnp.zeros(1, jnp.uint32), z, z, 1, 0)
         safe = jnp.maximum(idx, 0)
+        hit = idx >= 0
+        if self.max_words <= LANE_WORDS_MAX:
+            lanes = self.word_lanes()
+            out_lanes = [jnp.take(l, safe) for l in lanes]
+            lens = jnp.where(hit, jnp.take(self.lengths, safe), 0)
+            return VarBytes.from_lanes(out_lanes, lens)
         nw_src = _nwords(self.lengths)
         nw = jnp.where(idx >= 0, jnp.take(nw_src, safe), 0)
         total = int(nw.sum())  # the capacity decision (one scalar sync)

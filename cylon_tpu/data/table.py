@@ -34,7 +34,7 @@ from ..context import CylonContext
 from ..status import Code, CylonError
 from .column import (Column, align_string_columns, as_varbytes,
                      string_key_arrays, unify_dictionaries)
-from .strings import concat_varbytes
+from .strings import concat_varbytes, pair_k_words
 from .. import telemetry as _telemetry
 from ..ops import aggregates as _aggregates
 from ..ops import groupby as _groupby
@@ -717,8 +717,11 @@ def row_gids(left: Table, right: Table) -> Tuple[jnp.ndarray, jnp.ndarray]:
     keys_l, keys_r = [], []
     for a, b in zip(lcols, rcols):
         if a.is_varbytes:
-            keys_l.extend(a.varbytes.hash_keys())
-            keys_r.extend(b.varbytes.hash_keys())
+            kw = pair_k_words(a, b)
+            ka, _va, _fa = string_key_arrays(a, kw)
+            kb, _vb, _fb = string_key_arrays(b, kw)
+            keys_l.extend(ka)
+            keys_r.extend(kb)
         else:
             keys_l.append(_order.sort_keys([a])[0])
             keys_r.append(_order.sort_keys([b])[0])
@@ -732,14 +735,17 @@ def row_gids(left: Table, right: Table) -> Tuple[jnp.ndarray, jnp.ndarray]:
 # Free-function operator API (reference: table.hpp:228-387)
 # ---------------------------------------------------------------------------
 
-def _expanded_keys(cols: Sequence[Column]):
-    """Key arrays for join/groupby kernels: one array per plain column,
-    (h1, h2, h3, len) content-hash arrays per varbytes column (its device
-    identity — data/strings.py)."""
+def _expanded_keys(cols: Sequence[Column], paired: Sequence[Column] = None):
+    """Key arrays for join/groupby kernels: one array per plain column;
+    varbytes columns expand to raw word lanes (short rows, byte-exact)
+    or (h1, h2, h3, len) content hashes (long rows) — data/strings.py.
+    ``paired``: the other side's aligned key columns, so both sides
+    emit the same lane count (max of the two max_words)."""
     keys, valids, flags = [], [], []
-    for c in cols:
+    for j, c in enumerate(cols):
         if c.is_varbytes:
-            ks, vs, fs = string_key_arrays(c)
+            kw = pair_k_words(c, paired[j]) if paired is not None else None
+            ks, vs, fs = string_key_arrays(c, kw)
             keys.extend(ks)
             valids.extend(vs)
             flags.extend(fs)
@@ -786,22 +792,52 @@ def _join_plan_bytes_estimate(left: Table, right: Table) -> int:
 
 
 def _join_once(left: Table, right: Table, config: _join.JoinConfig) -> Table:
+    from ..data.strings import EXACT_KEY_WORDS, LANE_WORDS_MAX, VarBytes
+
     lcols, rcols = align_key_columns(left, right, config.left_column_idx,
                                      config.right_column_idx)
     # varbytes alignment may have lifted a dictionary key column: joins
     # read keys from the ALIGNED columns, payload from the originals
-    lkeys, lkvalid, str_flags = _expanded_keys(lcols)
-    rkeys, rkvalid, _ = _expanded_keys(rcols)
+    lkeys, lkvalid, str_flags = _expanded_keys(lcols, rcols)
+    rkeys, rkvalid, _ = _expanded_keys(rcols, lcols)
     lemit, remit = left.row_mask, right.row_mask
 
-    # varbytes payload columns can't ride fixed-width gathers — they are
-    # re-gathered from the returned indices after materialize
     lvb = [i for i, c in enumerate(left._columns) if c.is_varbytes]
     rvb = [i for i, c in enumerate(right._columns) if c.is_varbytes]
+    # INNER joins on byte-exact (word-lane) string keys emit identical
+    # bytes for both key columns — the right key's output aliases the
+    # left's, skipping its lanes and its materialization entirely
+    alias_rkeys = {}
+    if config.type == _join.JoinType.INNER:
+        for li, rj in zip(config.left_column_idx, config.right_column_idx):
+            a, b = left._columns[li], right._columns[rj]
+            if a.is_varbytes and b.is_varbytes:
+                kp = max(a.varbytes.max_words, b.varbytes.max_words)
+                if kp <= EXACT_KEY_WORDS:
+                    alias_rkeys[rj] = li
+    # short varbytes columns ride the materialize as fixed u32 word
+    # lanes appended after the real columns (output = strided layout,
+    # no varlen gather at all); long ones re-gather via VarBytes.take
+    lvb_fast = [i for i in lvb
+                if left._columns[i].varbytes.max_words <= LANE_WORDS_MAX]
+    rvb_fast = [j for j in rvb
+                if right._columns[j].varbytes.max_words <= LANE_WORDS_MAX
+                and j not in alias_rkeys]
     ldat = tuple(c.data for c in left._columns)
     lval = tuple(c.validity for c in left._columns)
     rdat = tuple(c.data for c in right._columns)
     rval = tuple(c.validity for c in right._columns)
+    l_lane_slots, r_lane_slots = {}, {}
+    for i in lvb_fast:
+        vb = left._columns[i].varbytes
+        l_lane_slots[i] = (len(ldat), vb.max_words)
+        ldat = ldat + tuple(vb.word_lanes())
+        lval = lval + (None,) * vb.max_words
+    for j in rvb_fast:
+        vb = right._columns[j].varbytes
+        r_lane_slots[j] = (len(rdat), vb.max_words)
+        rdat = rdat + tuple(vb.word_lanes())
+        rval = rval + (None,) * vb.max_words
 
     seq = left._ctx.get_next_sequence()
     # route: the sort-stream path handles single 4-byte keys; the
@@ -887,12 +923,32 @@ def _join_once(left: Table, right: Table, config: _join.JoinConfig) -> Table:
             for i, (d, v, c) in enumerate(zip(lod, lov, left._columns))]
     cols += [Column(d, c.dtype, v, c.dictionary, f"rt-{nl + j}")
              for j, (d, v, c) in enumerate(zip(rod, rov, right._columns))]
+
+    def lane_vb(od, slots, col_i, idx):
+        off, k = slots[col_i]
+        # miss/dead rows carry garbage lane values and lengths — zero
+        # the lengths so the strided gap-zero/read-range invariants hold
+        lens = jnp.where(idx >= 0, od[col_i], 0)
+        return VarBytes.from_lanes([od[off + q] for q in range(k)], lens)
+
     for i in lvb:
-        vb = left._columns[i].varbytes.take(lidx)
+        if i in l_lane_slots:
+            vb = lane_vb(lod, l_lane_slots, i, lidx)
+        else:
+            vb = left._columns[i].varbytes.take(lidx)
         cols[i] = Column(vb.lengths, left._columns[i].dtype, cols[i].validity,
                          None, cols[i].name, varbytes=vb)
     for j in rvb:
-        vb = right._columns[j].varbytes.take(ridx)
+        if j in alias_rkeys:
+            src = cols[alias_rkeys[j]]
+            cols[nl + j] = Column(src.data, right._columns[j].dtype,
+                                  cols[nl + j].validity, None,
+                                  cols[nl + j].name, varbytes=src.varbytes)
+            continue
+        if j in r_lane_slots:
+            vb = lane_vb(rod, r_lane_slots, j, ridx)
+        else:
+            vb = right._columns[j].varbytes.take(ridx)
         cols[nl + j] = Column(vb.lengths, right._columns[j].dtype,
                               cols[nl + j].validity, None, cols[nl + j].name,
                               varbytes=vb)
@@ -952,8 +1008,10 @@ def _append_unmatched_right(left: Table, right: Table,
     repeat dictionary-unification / content-hash pass)."""
     lcols, rcols = aligned if aligned is not None else align_key_columns(
         left, right, config.left_column_idx, config.right_column_idx)
-    lkeys, _lv_, _f = _expanded_keys(lcols)
-    rkeys, _rv_, _f2 = _expanded_keys(rcols)
+    # pairing is load-bearing: both sides must emit the same lane count
+    # per varbytes key column or dense_ranks_two zips misaligned arrays
+    lkeys, _lv_, _f = _expanded_keys(lcols, rcols)
+    rkeys, _rv_, _f2 = _expanded_keys(rcols, lcols)
     lv = _all_valid(lcols) & left.emit_mask()
     rv = _all_valid(rcols) & right.emit_mask()
     gl, gr = _order.dense_ranks_two(
@@ -1111,7 +1169,8 @@ def groupby_local(table: Table, index_col, aggregate_cols: List,
         if c.is_varbytes:
             # group identity = content hashes (grouping needs equality,
             # not order)
-            keys.extend(c.varbytes.hash_keys())
+            ks, _vs, _fs = string_key_arrays(c)
+            keys.extend(ks)
         else:
             keys.extend(_order.sort_keys([c]))
         if c.validity is not None:

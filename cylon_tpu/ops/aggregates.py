@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ..data.column import Column
+from ..dtypes import Type
 from ..status import Code, CylonError
 from .groupby import _max_of, _min_of
 
@@ -63,7 +64,11 @@ def agg_scalar(col: Column, op: str):
         win = lexsort_indices(keys)[:1]
         if not bool(jax.device_get(valid.any())):
             return None
-        return str(vb.take(win).to_host()[0])
+        # BINARY columns return bytes (a str() decode would corrupt
+        # non-UTF-8 payloads — round-3 advisor finding)
+        as_str = col.dtype.type != Type.BINARY
+        v = vb.take(win).to_host(as_str=as_str)[0]
+        return str(v) if as_str else bytes(v)
     if col.is_string:
         # min/max by dictionary order -> decode the code
         code = (_min if op == "min" else _max)(col.data, valid)

@@ -165,9 +165,16 @@ def _key_lanes(col):
     from .order import sort_keys
 
     if col.is_varbytes:
+        from ..data.strings import EXACT_KEY_WORDS
+
+        vb = col.varbytes
+        if vb.max_words <= EXACT_KEY_WORDS:
+            # byte-exact group identity: raw word lanes + length
+            return (vb.word_lanes() + [vb.lengths.astype(jnp.uint32)],
+                    col.validity is not None)
         # hash of the "" filler is shared by all nulls; the validity
         # lane (added by the caller) splits them from genuine ""
-        return list(col.varbytes.hash_keys()), col.validity is not None
+        return list(vb.hash_keys()), col.validity is not None
     bits = sort_keys([col])[0]
     w = bits.dtype.itemsize
     if w == 8:

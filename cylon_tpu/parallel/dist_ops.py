@@ -39,6 +39,7 @@ from .. import dtypes
 from ..context import CylonContext
 from ..data import table as table_mod
 from ..data.column import Column, unify_dictionaries
+from ..data.strings import pair_k_words as _pair_k
 from ..data.table import Table
 from ..ops import groupby as _groupby
 from ..ops import hash as _hash
@@ -49,7 +50,7 @@ from ..status import Code, CylonError
 from ..telemetry import phase as _phase
 from . import shard
 from ..util import capacity as _capacity
-from .shuffle import exchange, replicated_gather
+from .shuffle import count_pair, exchange, replicated_gather
 
 
 # ---------------------------------------------------------------------------
@@ -100,11 +101,62 @@ def _dist_string_keys(ctx: CylonContext, col: Column):
         shard.pin(vb.lengths, ctx))
 
 
-def _dist_col_keys(ctx: CylonContext, c: Column):
-    """One column's (key bit arrays, partition hash): the content-hash
-    quad computes ONCE and serves both the key lanes and the partition
-    target (h1)."""
+@lru_cache(maxsize=None)
+def _word_lanes_fn(mesh, k_lim: int):
+    """Per-shard word-lane lift of a sharded varbytes column
+    (shard-relative starts make each shard's gather self-contained —
+    no cross-shard indexing escapes the shard_map)."""
+    spec = P(mesh.axis_names[0])
+
+    def kernel(words, starts, lengths):
+        nw = (lengths + 3) >> 2
+        wcap = words.shape[0]
+        outs = []
+        for k in range(k_lim):
+            pos = jnp.clip(starts + k, 0, wcap - 1)
+            outs.append(jnp.where(k < nw, jnp.take(words, pos),
+                                  jnp.uint32(0)))
+        return tuple(outs)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=(spec,) * k_lim))
+
+
+def _dist_word_lanes(ctx: CylonContext, col: Column, k_lim: int) -> list:
+    vb = col.varbytes
+    return list(_word_lanes_fn(ctx.mesh, k_lim)(
+        shard.pin(vb.words, ctx), shard.pin(vb.starts, ctx),
+        shard.pin(vb.lengths, ctx)))
+
+
+def _lanes_hash(lanes: Sequence[jnp.ndarray], ln_u32) -> jnp.ndarray:
+    """Elementwise partition hash of word lanes + length — the exact-key
+    analog of the content-hash h1 (both sides of a join call this with
+    the SAME lane count, so equal bytes land on equal shards)."""
+    h = ln_u32 * np.uint32(0x9E3779B1)
+    for l in lanes:
+        h = h * np.uint32(31) + _hash.fmix32(l)
+    return _hash.fmix32(h)
+
+
+def _dist_col_keys(ctx: CylonContext, c: Column, k_words: int = None):
+    """One column's (key bit arrays, partition hash). Short varbytes
+    (≤ EXACT_KEY_WORDS words, the pair max when ``k_words`` is passed)
+    use raw word lanes + length — byte-exact; longer rows use the
+    content-hash quad. Plain columns use ordered bits."""
+    from ..data.strings import EXACT_KEY_WORDS
+
     if c.is_varbytes:
+        vb = c.varbytes
+        k = vb.max_words if k_words is None else max(int(k_words),
+                                                     vb.max_words)
+        if k <= EXACT_KEY_WORDS:
+            lanes = _dist_word_lanes(ctx, c, k)
+            ln = vb.lengths.astype(jnp.uint32)
+            h1 = _lanes_hash(lanes, ln)
+            if c.validity is not None:
+                h1 = jnp.where(c.validity, h1, jnp.uint32(0x9E3779B9))
+            return lanes + [ln], h1
         q = _dist_string_keys(ctx, c)
         h1 = q[0]
         if c.validity is not None:
@@ -113,15 +165,18 @@ def _dist_col_keys(ctx: CylonContext, c: Column):
     return [_order.sort_keys([c])[0]], _hash.hash_column(c)
 
 
-def _dist_key_bits(ctx: CylonContext, cols: Sequence[Column]):
+def _dist_key_bits(ctx: CylonContext, cols: Sequence[Column],
+                   paired: Sequence[Column] = None):
     """Key bit arrays, combined key-validity, and per-column partition
-    hashes for per-shard join/group kernels: ordered bits per plain
-    column, content-hash quads per varbytes column."""
+    hashes for per-shard join/group kernels. ``paired``: the other
+    side's aligned key columns (joins) so both sides emit matching lane
+    counts and partition hashes."""
     bits: list = []
     h1s: list = []
     kv = None
-    for c in cols:
-        b, h1 = _dist_col_keys(ctx, c)
+    for j, c in enumerate(cols):
+        kw = _pair_k(c, paired[j]) if paired is not None else None
+        b, h1 = _dist_col_keys(ctx, c, kw)
         bits.extend(b)
         h1s.append(h1)
         v = c.valid_mask()
@@ -141,13 +196,18 @@ def _targets_from_hashes(ctx: CylonContext, h1s: Sequence[jnp.ndarray]
     return (h % np.uint32(world)).astype(jnp.int32)
 
 
-def _partition_targets_dist(ctx: CylonContext, cols: Sequence[Column]
+def _partition_targets_dist(ctx: CylonContext, cols: Sequence[Column],
+                            paired: Sequence[Column] = None
                             ) -> jnp.ndarray:
     """Per-row target shard for mixed plain/varbytes key columns. Plain
     columns use the elementwise hash (sharding-transparent); varbytes
-    hash per shard."""
-    return _targets_from_hashes(
-        ctx, [_dist_col_keys(ctx, c)[1] for c in cols])
+    hash per shard. ``paired``: the other side's aligned key columns so
+    both sides hash with matching lane counts."""
+    h1s = []
+    for j, c in enumerate(cols):
+        kw = _pair_k(c, paired[j]) if paired is not None else None
+        h1s.append(_dist_col_keys(ctx, c, kw)[1])
+    return _targets_from_hashes(ctx, h1s)
 
 
 @lru_cache(maxsize=None)
@@ -228,23 +288,93 @@ def _exchange_varbytes_words(ctx: CylonContext, vb, targets, emit,
                                 int(wout["w"].shape[0]) // world))
 
 
+@lru_cache(maxsize=None)
+def _lanes_interleave_fn(mesh, K: int):
+    """Per-shard (lengths, lanes…) → (interleaved words, shard-relative
+    starts): the strided-layout assembly stays local to each shard (a
+    global reshape over the sharded row axis would re-layout)."""
+    spec = P(mesh.axis_names[0])
+
+    def kernel(lengths, *lanes):
+        n = lengths.shape[0]
+        nw = (lengths + 3) >> 2
+        masked = [jnp.where(k < nw, l, jnp.uint32(0))
+                  for k, l in enumerate(lanes)]
+        flat = jnp.stack(masked, axis=1).reshape(-1)
+        starts = jnp.arange(n, dtype=jnp.int32) * jnp.int32(K)
+        return flat, starts
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * (1 + K),
+                             out_specs=(spec, spec)))
+
+
+def _from_lanes_sharded(ctx: CylonContext, lanes, lengths):
+    """Strided sharded VarBytes from exchanged word lanes: each shard's
+    rows occupy [r_local*K, r_local*K + nw) of its own word segment —
+    shard-relative starts, shard_geom rows*K word stride."""
+    from ..data.strings import VarBytes
+
+    K = max(len(lanes), 1)
+    n = int(lengths.shape[0])
+    world = ctx.get_world_size()
+    rows = n // world
+    flat, starts = _lanes_interleave_fn(ctx.mesh, K)(lengths, *lanes)
+    return VarBytes(flat, starts, lengths, K, n * K,
+                    shard_geom=(rows, rows * K), stride=K)
+
+
 def _exchange_table(t: Table, targets, emit, ctx: CylonContext,
-                    extra: Optional[dict] = None):
+                    extra: Optional[dict] = None, counts=None):
     """Shuffle a whole table's columns (fixed-width AND varbytes) plus
     optional extra per-row arrays. Returns (columns, new_emit,
-    extra_out)."""
+    extra_out).
+
+    Short varbytes columns (≤ LANE_WORDS_MAX words) ride the ROW
+    exchange as fixed word lanes — no second word-level exchange, no
+    extra count sync, no starts reconcile (the lane payloads move like
+    any fixed-width column and reassemble as a strided layout). Long
+    varbytes keep the word-leg exchange."""
+    from ..data.strings import LANE_WORDS_MAX
+
     payload = dict(extra or {})
+    lane_cols = {}
     for i, c in enumerate(t._columns):
         payload[f"d{i}"] = c.data  # byte lengths for varbytes columns
-        payload[f"v{i}"] = c.valid_mask()
+        if c.validity is not None:
+            # all-valid columns skip the mask leaf entirely (validity
+            # None round-trips as None — one less sort operand per col)
+            payload[f"v{i}"] = c.valid_mask()
+        if c.is_varbytes and c.varbytes.max_words <= LANE_WORDS_MAX:
+            vb = c.varbytes
+            lanes = _word_lanes_fn(ctx.mesh, vb.max_words)(
+                shard.pin(vb.words, ctx), shard.pin(vb.starts, ctx),
+                shard.pin(vb.lengths, ctx))
+            lane_cols[i] = vb.max_words
+            for k, l in enumerate(lanes):
+                payload[f"d{i}w{k}"] = l
     payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
-    out, new_emit, _cap, meta = exchange(payload, targets, emit, ctx)
+    if counts is None:
+        out, new_emit, _cap, meta = exchange(payload, targets, emit, ctx)
+    else:
+        out, new_emit, _cap, meta = exchange(payload, targets, emit, ctx,
+                                             counts=counts)
     cols = []
     for i, c in enumerate(t._columns):
-        d, v = out[f"d{i}"], out[f"v{i}"]
+        d, v = out[f"d{i}"], out.get(f"v{i}")
         if c.is_varbytes:
-            vb = _exchange_varbytes_words(ctx, c.varbytes, targets, emit,
-                                          d, meta)
+            # the padded-mode exchange over-reads neighbor rows into dead
+            # slots, so dead rows can carry live rows' byte lengths; the
+            # lane masking and every later _word_row_map pass need dead
+            # rows at nw=0 to keep the monotone-starts invariant
+            # (strings.py _word_row_map), so zero them first
+            d = jnp.where(new_emit, d, jnp.zeros((), d.dtype))
+            if i in lane_cols:
+                vb = _from_lanes_sharded(
+                    ctx, [out[f"d{i}w{k}"] for k in range(lane_cols[i])],
+                    d)
+            else:
+                vb = _exchange_varbytes_words(ctx, c.varbytes, targets,
+                                              emit, d, meta)
             cols.append(Column(vb.lengths, c.dtype, v, None, c.name,
                                varbytes=vb))
         else:
@@ -671,26 +801,60 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
     seq = ctx.get_next_sequence()
     shuffled = []
     with _phase("distributed_join.shuffle", seq):
-        for t, kcols, kidx in ((left_d, lcols, lidx), (right_d, rcols, ridx)):
-            bits, kv, h1s = _dist_key_bits(ctx, kcols)
+        plan = []
+        for t, kcols, kidx, other in ((left_d, lcols, lidx, rcols),
+                                      (right_d, rcols, ridx, lcols)):
             sig = shard.partition_signature(kcols, kidx, world)
             if sig is not None and t._hash_partitioned == sig \
                     and not force_exchange:
                 # co-partitioned (prior shuffle or distribute_by_key host
                 # ingest): rows are already hash-placed — skip the exchange
-                shuffled.append((tuple(shard.pin(b, ctx) for b in bits),
-                                 shard.pin(kv, ctx),
-                                 shard.pin(t.emit_mask(), ctx), t._columns))
+                plan.append(("skip", t, None, None))
                 continue
-            targets = shard.pin(_targets_from_hashes(ctx, h1s), ctx)
-            extra = {f"k{j}": b for j, b in enumerate(bits)}
-            extra["kv"] = kv
-            cols, emit, xout = _exchange_table(
-                t, targets, shard.pin(t.emit_mask(), ctx), ctx, extra)
-            kbits = tuple(xout[f"k{j}"] for j in range(len(bits)))
-            shuffled.append((kbits, xout["kv"], emit, cols))
+            # targets need only the partition hashes; key BITS are
+            # recomputed from the shuffled columns below (elementwise /
+            # per-shard work), so the exchange moves ~2/3 fewer lanes —
+            # measured 813 ms -> the bare-columns exchange cost at 16M
+            targets = shard.pin(
+                _partition_targets_dist(ctx, kcols, other), ctx)
+            emit = shard.pin(t.emit_mask(), ctx)
+            plan.append(("exchange", t, targets, emit))
+        # both sides exchanging: ONE fused count program + ONE host sync
+        # covers both shuffles (the reference pays a header phase per
+        # table per peer, mpi_channel.cpp:211-225; here the axon tunnel
+        # charges ~100 ms per round trip, so fusing halves the fixed
+        # cost of the composition)
+        ex = [p for p in plan if p[0] == "exchange"]
+        pair = {}
+        if len(ex) == 2:
+            cl, cr = count_pair(ex[0][2], ex[0][3], ex[1][2], ex[1][3],
+                                ctx)
+            pair[id(ex[0])] = cl
+            pair[id(ex[1])] = cr
+        for p in plan:
+            kind, t, targets, emit = p
+            if kind == "skip":
+                shuffled.append((t._columns, t.row_mask,
+                                 shard.pin(t.emit_mask(), ctx)))
+                continue
+            cols, emit_s, _x = _exchange_table(
+                t, targets, emit, ctx, counts=pair.get(id(p)))
+            shuffled.append((cols, emit_s, emit_s))
 
-    (lkb, lkv, lemit, lcols_s), (rkb, rkv, remit, rcols_s) = shuffled
+    # rebuild key bits from the SHUFFLED columns (word lanes reshape out
+    # of the strided layout; plain columns are elementwise ordered-bits)
+    (lcols_all, lmask, lemit), (rcols_all, rmask, remit) = shuffled
+    left_s = Table(list(lcols_all), ctx, lmask)
+    right_s = Table(list(rcols_all), ctx, rmask)
+    lcols2, rcols2 = _align_key_columns_dist(ctx, left_s, right_s,
+                                             lidx, ridx)
+    lkb, lkv, _h1s_l = _dist_key_bits(ctx, lcols2, rcols2)
+    rkb, rkv, _h1s_r = _dist_key_bits(ctx, rcols2, lcols2)
+    lkb = tuple(shard.pin(b, ctx) for b in lkb)
+    rkb = tuple(shard.pin(b, ctx) for b in rkb)
+    lkv = shard.pin(lkv, ctx)
+    rkv = shard.pin(rkv, ctx)
+    lcols_s, rcols_s = lcols_all, rcols_all
     lvb = [i for i, c in enumerate(lcols_s) if c.is_varbytes]
     rvb = [i for i, c in enumerate(rcols_s) if c.is_varbytes]
     ldat = tuple(shard.pin(c.data, ctx) for c in lcols_s)
@@ -908,12 +1072,16 @@ def distributed_join_ring(left: Table, right: Table,
     rows where cap_step covers the worst (shard, step) block — heavy key
     skew inflates it; the shuffle path degrades more gracefully there.
     """
+    from ..data.strings import LANE_WORDS_MAX
+
     ctx = left._ctx
     world = ctx.get_world_size()
     jt = config.type
     if world == 1 or jt == _join.JoinType.FULL_OUTER or \
-            any(c.is_varbytes for c in left._columns + right._columns):
-        # varbytes payload can't ride the ring's fixed-width rotation yet
+            any(c.is_varbytes and c.varbytes.max_words > LANE_WORDS_MAX
+                for c in left._columns + right._columns):
+        # long varbytes payload can't ride the ring's fixed-width
+        # rotation (short rows ride as word lanes below)
         return distributed_join(left, right, config)
 
     left_d = shard.distribute(left, ctx)
@@ -927,16 +1095,32 @@ def distributed_join_ring(left: Table, right: Table,
         a_t, a_cols, b_t, b_cols = left_d, lcols, right_d, rcols
     emit_un_a = jt != _join.JoinType.INNER
 
-    def prep(t, cols):
-        bits = tuple(shard.pin(b, ctx) for b in _order.sort_keys(cols))
-        kv = shard.pin(_all_valid(cols), ctx)
+    def prep(t, cols, other_cols):
+        # varbytes keys become per-shard word lanes (byte-exact) or the
+        # content-hash quad; either way the bit arrays rotate like any
+        # fixed lane. Short varbytes PAYLOADS ride as appended word
+        # lanes (the ArrowJoin analog now streams whole tables incl.
+        # strings, reference arrow_join.hpp:50-198).
+        bits, kv, _h = _dist_key_bits(ctx, cols, other_cols)
+        bits = tuple(shard.pin(b, ctx) for b in bits)
+        kv = shard.pin(kv, ctx)
         emit = shard.pin(t.emit_mask(), ctx)
-        dat = tuple(shard.pin(c.data, ctx) for c in t._columns)
-        val = tuple(shard.pin(c.valid_mask(), ctx) for c in t._columns)
-        return bits, kv, emit, dat, val
+        dat = [shard.pin(c.data, ctx) for c in t._columns]
+        val = [shard.pin(c.valid_mask(), ctx) for c in t._columns]
+        lane_slots = {}
+        for i, c in enumerate(t._columns):
+            if c.is_varbytes:
+                vb = c.varbytes
+                lanes = _word_lanes_fn(ctx.mesh, vb.max_words)(
+                    shard.pin(vb.words, ctx), shard.pin(vb.starts, ctx),
+                    shard.pin(vb.lengths, ctx))
+                lane_slots[i] = (len(dat), vb.max_words)
+                dat.extend(lanes)
+                val.extend([shard.pin(c.valid_mask(), ctx)] * vb.max_words)
+        return bits, kv, emit, tuple(dat), tuple(val), lane_slots
 
-    abits, akv, aemit, adat, aval = prep(a_t, a_cols)
-    bbits, bkv, bemit, bdat, bval = prep(b_t, b_cols)
+    abits, akv, aemit, adat, aval, a_lane_slots = prep(a_t, a_cols, b_cols)
+    bbits, bkv, bemit, bdat, bval, b_lane_slots = prep(b_t, b_cols, a_cols)
 
     seq = ctx.get_next_sequence()
     with _phase("ring_join.count", seq):
@@ -957,6 +1141,7 @@ def distributed_join_ring(left: Table, right: Table,
     budget = ctx.memory_pool.comm_budget_bytes()
     row_bytes = sum(
         int(np.dtype(c.data.dtype).itemsize) + 1
+        + (5 * c.varbytes.max_words if c.is_varbytes else 0)
         for c in a_t._columns + b_t._columns)
     over_budget = bool(budget) and slab * row_bytes > budget
     # absolute floor: tiny slabs are free regardless of ratio — without
@@ -972,11 +1157,28 @@ def distributed_join_ring(left: Table, right: Table,
             ctx.mesh, emit_un_a, cap_step, cap_extra, len(abits))(
             abits, akv, aemit, bbits, bkv, bemit, adat, aval, bdat, bval)
 
+    def build_side(slabs_d, slabs_v, t, lane_slots, prefix):
+        cols = []
+        for i, c in enumerate(t._columns):
+            d, v = slabs_d[i], slabs_v[i]
+            if c.is_varbytes:
+                off, k = lane_slots[i]
+                # unmatched/dead/null slab rows carry garbage lanes —
+                # zero their lengths (v is src-validity AND hit; slab
+                # init is zero for never-written rows)
+                lens = jnp.where(v, d, 0)
+                vb = _from_lanes_sharded(
+                    ctx, [slabs_d[off + q] for q in range(k)], lens)
+                cols.append(Column(vb.lengths, c.dtype, v, None,
+                                   f"{prefix}-{i}", varbytes=vb))
+            else:
+                cols.append(Column(d, c.dtype, v, c.dictionary,
+                                   f"{prefix}-{i}"))
+        return cols
+
     na = a_t.column_count
-    a_cols_out = _rebuild_columns(sa, sav, a_t,
-                                  [f"a-{i}" for i in range(na)])
-    b_cols_out = _rebuild_columns(
-        sb, sbv, b_t, [f"b-{j}" for j in range(b_t.column_count)])
+    a_cols_out = build_side(sa, sav, a_t, a_lane_slots, "a")
+    b_cols_out = build_side(sb, sbv, b_t, b_lane_slots, "b")
     if jt == _join.JoinType.RIGHT:
         cols = b_cols_out + a_cols_out
         nl = b_t.column_count
@@ -1016,7 +1218,8 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
     seq = ctx.get_next_sequence()
     shuffled = []
     with _phase("distributed_set_op.shuffle", seq):
-        for cols, t in ((lcols, left_d), (rcols, right_d)):
+        for cols, t, other in ((lcols, left_d, rcols),
+                               (rcols, right_d, lcols)):
             # aligned key columns ARE the payload for set ops; wrap them
             # in a view table so _exchange_table moves varbytes content
             view = Table(list(cols), ctx, t.row_mask)
@@ -1024,7 +1227,7 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
             nbits = 0
             h1s = []
             for ci, c in enumerate(cols):
-                b, h1 = _dist_col_keys(ctx, c)
+                b, h1 = _dist_col_keys(ctx, c, _pair_k(c, other[ci]))
                 h1s.append(h1)
                 for arr in b:
                     extra[f"k{nbits}"] = arr

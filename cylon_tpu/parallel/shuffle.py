@@ -269,9 +269,39 @@ def _exchange_fn(mesh, block: int, rounds: int, cap_out: int):
 PADDED_WASTE_FACTOR = 2
 
 
+@lru_cache(maxsize=None)
+def _count2_fn(mesh):
+    """Both sides' send-count matrices in ONE compiled program (one
+    host sync for a two-table shuffle instead of two — the axon tunnel
+    charges ~100 ms per round trip)."""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+
+    def kernel(t1, e1, t2, e2):
+        a = jnp.where(e1, t1.astype(jnp.int32), world)
+        b = jnp.where(e2, t2.astype(jnp.int32), world)
+        both = jnp.stack([_target_counts(a, world),
+                          _target_counts(b, world)])
+        return replicated_gather(both, axis, world)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 4,
+                             out_specs=P()))
+
+
+def count_pair(targets1, emit1, targets2, emit2, ctx: CylonContext):
+    """Host (countsL, countsR) for two shuffles, one program + one sync.
+    Feed the results to exchange(..., counts=...)."""
+    # result is [src, 2, dst] (replicated_gather stacks per source)
+    both = np.asarray(jax.device_get(
+        _count2_fn(ctx.mesh)(targets1, emit1, targets2, emit2)))
+    return both[:, 0, :], both[:, 1, :]
+
+
 def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
              emit: jnp.ndarray, ctx: CylonContext,
-             max_block: Optional[int] = None
+             max_block: Optional[int] = None,
+             counts: Optional[np.ndarray] = None
              ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, int, dict]:
     """Shuffle a pytree of row-sharded per-row arrays to their target shards.
 
@@ -296,9 +326,10 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
     """
     world = ctx.get_world_size()
     seq = ctx.get_next_sequence()
-    with _phase("shuffle.count", seq):
-        counts = np.asarray(jax.device_get(
-            _count_fn(ctx.mesh)(targets, emit)))
+    if counts is None:
+        with _phase("shuffle.count", seq):
+            counts = np.asarray(jax.device_get(
+                _count_fn(ctx.mesh)(targets, emit)))
     max_pair = int(counts.max()) if counts.size else 0
     recv_max = int(counts.sum(axis=0).max()) if counts.size else 0
     mb = max_block if max_block is not None else MAX_BLOCK

@@ -1,0 +1,112 @@
+"""Phase-timed breakdown of the two-phase exchange (VERDICT r03 #3).
+
+Times each phase of parallel/shuffle.exchange on the attached backend
+with honest syncs (jax.block_until_ready is a no-op on axon, so every
+phase is forced with a one-element device_get probe) and writes a JSON
+breakdown next to the repo's bench artifacts.
+
+Usage: python scripts/profile_shuffle.py [n_rows_log2=24]
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main(log2n: int = 24) -> dict:
+    import cylon_tpu as ct
+    from cylon_tpu.ops import hash as _hash
+    from cylon_tpu.parallel import shard as _shard
+    from cylon_tpu.parallel import shuffle as _shuffle
+
+    ctx = ct.CylonContext.InitDistributed(ct.TPUConfig())
+    world = ctx.get_world_size()
+    n = 1 << log2n
+    rng = np.random.default_rng(2)
+    t = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n, n),
+        "v": rng.normal(size=n).astype(np.float32)})
+    t = _shard.distribute(t, ctx)
+    targets = _shard.pin(_hash.partition_targets([t.get_column(0)], world),
+                         ctx)
+    emit = _shard.pin(t.emit_mask(), ctx)
+    payload = {"k": _shard.pin(t.get_column(0).data, ctx),
+               "v": _shard.pin(t.get_column(1).data, ctx)}
+
+    def sync(x):
+        jax.device_get(jax.tree.leaves(x)[0].reshape(-1)[:1])
+
+    def best_of(f, iters=3):
+        f()
+        b = 1e9
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            f()
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    res = {"n_rows": n, "world": world,
+           "backend": jax.devices()[0].platform}
+
+    # phase 0: bare host round trip (the axon tunnel's fixed cost — every
+    # sync below includes one of these)
+    probe = jnp.zeros(1, jnp.int32)
+    res["host_round_trip_s"] = best_of(lambda: jax.device_get(probe[0]))
+
+    # phase 1: count program (compiled compute, forced via device_get)
+    cf = _shuffle._count_fn(ctx.mesh)
+
+    def count_only():
+        sync(cf(targets, emit))
+    res["count_program_s"] = best_of(count_only)
+
+    # phase 2: the count HOST SYNC as exchange() actually pays it
+    # (full [W,W] matrix device_get)
+    def count_sync():
+        np.asarray(jax.device_get(cf(targets, emit)))
+    res["count_plus_fetch_s"] = best_of(count_sync)
+
+    # phase 3: exchange program alone (counts precomputed)
+    counts = np.asarray(jax.device_get(cf(targets, emit)))
+
+    def exchange_only():
+        out, new_emit, _cap, _meta = _shuffle.exchange(
+            payload, targets, emit, ctx, counts=counts)
+        sync(out)
+    res["exchange_program_s"] = best_of(exchange_only)
+
+    # end to end (count + sync + exchange)
+    def full():
+        out, new_emit, _cap, _meta = _shuffle.exchange(
+            payload, targets, emit, ctx)
+        sync(out)
+    res["end_to_end_s"] = best_of(full)
+
+    bytes_moved = n * 12  # k int64? int32+float32+mask-ish; report both
+    row_bytes = sum(int(np.dtype(np.asarray(v).dtype).itemsize)
+                    for v in payload.values())
+    res["row_bytes"] = row_bytes
+    res["gbps_end_to_end"] = n * row_bytes / res["end_to_end_s"] / 1e9
+    res["gbps_exchange_only"] = (n * row_bytes
+                                 / res["exchange_program_s"] / 1e9)
+    del bytes_moved
+    for k, v in res.items():
+        if isinstance(v, float):
+            res[k] = round(v, 5)
+    return res
+
+
+if __name__ == "__main__":
+    log2n = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    out = main(log2n)
+    print(json.dumps(out))
+    with open(os.path.join(os.path.dirname(__file__), "..",
+                           f"PROFILE_shuffle.json"), "w") as f:
+        json.dump(out, f, indent=1)
