@@ -286,6 +286,8 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
         suite["set_union"] = bench_setops(ctx, n_rows // 2, iters)
         suite["q5_pipeline"] = bench_q5_pipeline(ctx, n_rows // 2, iters)
         suite["string_join"] = bench_string_join(ctx, n_rows // 4, iters)
+        suite["hbm_blocked_join"] = bench_hbm_blocked_join(
+            ctx, n_rows * 16, n_rows * 4)
     rps = dist_res["rows_per_s_per_chip"]
     return {
         "metric": "dist_inner_join_rows_per_sec_per_chip",
@@ -308,6 +310,61 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
                       for k, v in suite.items()},
         },
     }
+
+
+def bench_hbm_blocked_join(ctx, n_probe: int, n_build: int) -> dict:
+    """>HBM working-set join (VERDICT r03 #6): the probe side is big
+    enough that the plan estimate exceeds the HBM headroom and
+    join_blocked auto-engages (table.py join() routing). Data generates
+    ON DEVICE (a host transfer of GBs through the axon tunnel would
+    dominate the wall clock)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cylon_tpu import dtypes
+    from cylon_tpu.data.column import Column
+    from cylon_tpu.data.table import Table
+    from cylon_tpu.data import table as table_mod
+
+    def dev_table(n, seed, name):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        k = jax.random.randint(k1, (n,), 0, n_probe, dtype=jnp.int32)
+        v = jax.random.normal(k2, (n,), dtype=jnp.float32)
+        return Table([Column(k, dtypes.Int32(), None, None, "k"),
+                      Column(v, dtypes.Float(), None, None, name)], ctx)
+
+    left = dev_table(n_probe, 1, "v")
+    right = dev_table(n_build, 2, "w")
+    engaged = {}
+    orig = table_mod.join_blocked
+
+    def spy(*a, **kw):
+        engaged["blocked"] = True
+        return orig(*a, **kw)
+
+    table_mod.join_blocked = spy
+    try:
+        out = {}
+
+        def one():
+            t = left.join(right, "inner", on="k")
+            _sync(t)
+            out["t"] = t
+
+        wall = _time(one, 1)  # warmup (compile) + one timed run
+        rows = out["t"].row_count
+    finally:
+        table_mod.join_blocked = orig
+    total = n_probe + n_build
+    blocked = bool(engaged.get("blocked", False))
+    return {
+        # a rows/s number for the blocked path only counts if the
+        # blocked path actually ran — otherwise report the miss loudly
+        "rows_per_s_per_chip": round(total / wall, 1) if blocked else 0.0,
+        "wall_s": round(wall, 4), "out_rows": int(rows),
+        "probe_rows": n_probe, "build_rows": n_build,
+        "blocked_engaged": blocked,
+        "working_set_gb": round((n_probe + n_build) * 8 * 8 / 1e9, 2)}
 
 
 def bench_q5_pipeline(ctx, n_rows: int, iters: int) -> dict:

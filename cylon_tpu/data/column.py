@@ -75,20 +75,21 @@ class Column:
         safe = np.array([v if ok else filler for v, ok in zip(obj, validity)],
                         dtype=object)
         n = len(obj)
-        if any(isinstance(v, bytes) for v in safe):
-            # BINARY values: straight to varbytes (no sorted-str vocab —
-            # a str() decode would corrupt non-UTF-8 payloads)
-            vb = VarBytes.from_host(safe)
-            return Column.from_varbytes(
-                vb, _dev_mask(validity if not validity.all() else None),
-                name, dtypes.Binary())
         thresh = min(DICT_MAX_VOCAB, max(16, int(n * DICT_MAX_RATIO)))
         # chunked distinct probe with early bail: the varbytes branch
         # (exactly the high-cardinality case) must not pay np.unique's
-        # O(n log n) host string sort just to discard it
+        # O(n log n) host string sort just to discard it. The same
+        # chunked pass detects BINARY values (bytes must go straight to
+        # varbytes — a str() decode corrupts non-UTF-8 payloads).
         seen: set = set()
         for lo in range(0, n, 1 << 16):
-            seen.update(safe[lo: lo + (1 << 16)])
+            chunk = safe[lo: lo + (1 << 16)]
+            seen.update(chunk)
+            if any(isinstance(v, bytes) for v in chunk):
+                vb = VarBytes.from_host(safe)
+                return Column.from_varbytes(
+                    vb, _dev_mask(validity if not validity.all() else None),
+                    name, dtypes.Binary())
             if len(seen) > thresh:
                 vb = VarBytes.from_host(safe)
                 return Column.from_varbytes(

@@ -348,6 +348,26 @@ class VarBytes:
     def sortable_on_device(self) -> bool:
         return self.max_words <= SORT_PREFIX_WORDS
 
+    def equals_rows(self, other: "VarBytes") -> jnp.ndarray:
+        """Exact per-row byte equality against another VarBytes of the
+        same row count — the opt-in verification pass behind
+        ``join(..., exact=True)`` for long keys whose join identity is
+        the 96-bit content hash (short keys ≤ EXACT_KEY_WORDS are
+        byte-exact by construction and never need this). Bounded loop
+        over max(max_words) word positions; each step is two aligned
+        gathers + a compare (reference bar: the hash-join kernel
+        re-checks true keys after hash match,
+        arrow_hash_kernels.hpp:110-185)."""
+        eq = self.lengths == other.lengths
+        nw = _nwords(self.lengths)
+        sa, sb = self.eff_starts(), other.eff_starts()
+        ca, cb = self.words.shape[0], other.words.shape[0]
+        for k in range(max(self.max_words, other.max_words)):
+            wa = jnp.take(self.words, jnp.clip(sa + k, 0, ca - 1))
+            wb = jnp.take(other.words, jnp.clip(sb + k, 0, cb - 1))
+            eq = eq & ((k >= nw) | (wa == wb))
+        return eq
+
     def equals_literal(self, value) -> jnp.ndarray:
         """Exact per-row equality against one host literal (bounded loop
         over the literal's words)."""

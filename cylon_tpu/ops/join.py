@@ -70,11 +70,16 @@ class JoinConfig:
     of column indices (multi-column keys are first-class here)."""
 
     def __init__(self, join_type: JoinType, left_column_idx, right_column_idx,
-                 algorithm: JoinAlgorithm = JoinAlgorithm.SORT):
+                 algorithm: JoinAlgorithm = JoinAlgorithm.SORT,
+                 exact: bool = False):
         self.type = join_type
         self.algorithm = algorithm
         self.left_column_idx = _as_list(left_column_idx)
         self.right_column_idx = _as_list(right_column_idx)
+        # opt-in byte-verification of hash-identified varbytes keys
+        # (keys <= EXACT_KEY_WORDS are byte-exact by construction; long
+        # keys join on the 96-bit content hash unless exact=True)
+        self.exact = exact
 
     @staticmethod
     def InnerJoin(l, r, algorithm: JoinAlgorithm = JoinAlgorithm.SORT):

@@ -743,13 +743,76 @@ def shuffle(table: Table, hash_columns: Sequence) -> Table:
 def hash_partition(table: Table, hash_columns: Sequence,
                    num_partitions: int) -> dict:
     """Split into a {partition_id: Table} map (reference: HashPartition,
-    table.hpp:354, table.cpp:102-160 — C++ kernels there, the native host
-    partitioner here: the result is host-resident per-partition tables,
-    so one ct_row_hash + stable bucket order replaces num_partitions
-    device filter passes)."""
+    table.hpp:354, table.cpp:102-160). DEVICE-RESIDENT: one fused
+    stable sort by target bucket carries every column as an operand
+    (the same trick the exchange's bucket sort uses), then each
+    partition is a contiguous device slice — rows never leave HBM
+    (round-3 verdict: the old host-numpy round trip was wrong for a
+    device table mid-pipeline). Long varbytes columns (> LANE_WORDS_MAX
+    words) fall back to the native host partitioner."""
     from ..data.column import Column
+    from ..data.strings import LANE_WORDS_MAX, VarBytes
 
     idxs = [table._col_index(c) for c in hash_columns]
+    if any(c.is_varbytes and c.varbytes.max_words > LANE_WORDS_MAX
+           for c in table._columns):
+        return _hash_partition_host(table, idxs, num_partitions)
+
+    t = table
+    ctx = t._ctx
+    emit = t.emit_mask()
+    targets = _hash.partition_targets(
+        [t._columns[i] for i in idxs], num_partitions)
+    # varbytes key columns need content hashes, not length hashes —
+    # partition_targets handles them via hash_column internally; short
+    # varbytes PAYLOADS ride the sort as word lanes below
+    tkey = jnp.where(emit, targets, jnp.int32(num_partitions))
+    leaves = []
+    desc = []  # (col_idx, kind) per leaf, kind in d/v/w
+    for ci, c in enumerate(t._columns):
+        leaves.append(c.data)
+        desc.append((ci, "d"))
+        if c.validity is not None:
+            leaves.append(c.valid_mask())
+            desc.append((ci, "v"))
+        if c.is_varbytes:
+            for l in c.varbytes.word_lanes():
+                leaves.append(l)
+                desc.append((ci, "w"))
+    res = jax.lax.sort((tkey,) + tuple(leaves), num_keys=1,
+                       is_stable=True)
+    sorted_leaves = list(res[1:])
+    counts = np.asarray(jax.device_get(jax.ops.segment_sum(
+        jnp.ones(tkey.shape[0], jnp.int32), tkey,
+        num_segments=num_partitions + 1)))[:num_partitions]
+    offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+
+    out = {}
+    for p in range(num_partitions):
+        lo, hi = int(offs[p]), int(offs[p + 1])
+        cols = []
+        by_col = {}
+        for (ci, kind), leaf in zip(desc, sorted_leaves):
+            by_col.setdefault(ci, {}).setdefault(kind, []).append(
+                leaf[lo:hi])
+        for ci, c in enumerate(t._columns):
+            parts = by_col[ci]
+            d = parts["d"][0]
+            v = parts.get("v", [None])[0]
+            if c.is_varbytes:
+                vb = VarBytes.from_lanes(parts["w"], d)
+                cols.append(Column(vb.lengths, c.dtype, v, None, c.name,
+                                   varbytes=vb))
+            else:
+                cols.append(Column(d, c.dtype, v, c.dictionary, c.name))
+        out[p] = Table(cols, ctx)
+    return out
+
+
+def _hash_partition_host(table: Table, idxs, num_partitions: int) -> dict:
+    """Host partitioner (native ct_row_hash) — the long-varbytes path."""
+    from ..data.column import Column
+
     t = table.compact()
     host, valids, counts, order, offs = shard.host_partition_arrays(
         t, idxs, num_partitions)
@@ -792,6 +855,19 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
         # reference parity: world==1 short-circuits to the local join
         # (table.cpp:662-669)
         return table_mod.join(left, right, config)
+    if getattr(config, "exact", False):
+        from ..data.strings import EXACT_KEY_WORDS
+
+        for li, rj in zip(config.left_column_idx, config.right_column_idx):
+            a, b = left._columns[li], right._columns[rj]
+            kw = _pair_k(a, b)
+            if kw is not None and kw > EXACT_KEY_WORDS:
+                raise CylonError(
+                    Code.NotImplemented,
+                    "exact=True on distributed joins with long (> "
+                    f"{EXACT_KEY_WORDS * 4}-byte) varbytes keys is not "
+                    "supported yet; dictionary-encode the key column "
+                    "(keys up to that size are byte-exact by default)")
 
     left_d = shard.distribute(left, ctx)
     right_d = shard.distribute(right, ctx)
@@ -1083,6 +1159,19 @@ def distributed_join_ring(left: Table, right: Table,
         # long varbytes payload can't ride the ring's fixed-width
         # rotation (short rows ride as word lanes below)
         return distributed_join(left, right, config)
+    if getattr(config, "exact", False):
+        from ..data.strings import EXACT_KEY_WORDS
+
+        for li, rj in zip(config.left_column_idx,
+                          config.right_column_idx):
+            kw = _pair_k(left._columns[li], right._columns[rj])
+            if kw is not None and kw > EXACT_KEY_WORDS:
+                raise CylonError(
+                    Code.NotImplemented,
+                    "exact=True on ring joins with long varbytes keys "
+                    "is not supported; dictionary-encode the key column "
+                    f"(keys up to {EXACT_KEY_WORDS * 4} bytes are "
+                    "byte-exact by default)")
 
     left_d = shard.distribute(left, ctx)
     right_d = shard.distribute(right, ctx)
@@ -1218,32 +1307,44 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
     seq = ctx.get_next_sequence()
     shuffled = []
     with _phase("distributed_set_op.shuffle", seq):
+        # exchange ONLY the aligned columns; key bits (word lanes /
+        # hash quads / ordered bits) and validity key lanes are
+        # recomputed per shard from the shuffled columns — the exchange
+        # stops double-shipping the lanes (round-4 review finding).
+        # Both counts fuse into one program + one host sync.
+        sides = []
         for cols, t, other in ((lcols, left_d, rcols),
                                (rcols, right_d, lcols)):
-            # aligned key columns ARE the payload for set ops; wrap them
-            # in a view table so _exchange_table moves varbytes content
             view = Table(list(cols), ctx, t.row_mask)
-            extra = {}
-            nbits = 0
-            h1s = []
-            for ci, c in enumerate(cols):
-                b, h1 = _dist_col_keys(ctx, c, _pair_k(c, other[ci]))
-                h1s.append(h1)
-                for arr in b:
-                    extra[f"k{nbits}"] = arr
-                    nbits += 1
-                if has_validity[ci]:
-                    # validity participates in the row key (nulls compare
-                    # equal, matching the reference's set-distinct semantics)
-                    extra[f"k{nbits}"] = c.valid_mask().astype(jnp.uint8)
-                    nbits += 1
-            targets = shard.pin(_targets_from_hashes(ctx, h1s), ctx)
-            out_cols, emit, xout = _exchange_table(
-                view, targets, shard.pin(t.emit_mask(), ctx), ctx, extra)
-            kbits = tuple(xout[f"k{j}"] for j in range(nbits))
-            shuffled.append((kbits, emit, out_cols))
+            targets = shard.pin(
+                _partition_targets_dist(ctx, cols, other), ctx)
+            emit = shard.pin(t.emit_mask(), ctx)
+            sides.append((view, targets, emit))
+        cl, cr = count_pair(sides[0][1], sides[0][2],
+                            sides[1][1], sides[1][2], ctx)
+        for (view, targets, emit), cnt in zip(sides, (cl, cr)):
+            out_cols, emit_s, _x = _exchange_table(view, targets, emit,
+                                                   ctx, counts=cnt)
+            shuffled.append((emit_s, out_cols))
 
-    (lkb, lemit, lcols_s), (rkb, remit, rcols_s) = shuffled
+    (lemit, lcols_s), (remit, rcols_s) = shuffled
+    lcols_s2, rcols_s2 = _align_key_columns_dist(
+        ctx, Table(list(lcols_s), ctx, lemit),
+        Table(list(rcols_s), ctx, remit), all_idx, all_idx)
+
+    def rebits(cols, other, emit):
+        bits = []
+        for ci, c in enumerate(cols):
+            b, _h1 = _dist_col_keys(ctx, c, _pair_k(c, other[ci]))
+            bits.extend(b)
+            if has_validity[ci]:
+                # validity participates in the row key (nulls compare
+                # equal, matching the reference's set-distinct semantics)
+                bits.append(c.valid_mask().astype(jnp.uint8))
+        return tuple(shard.pin(b, ctx) for b in bits)
+
+    lkb = rebits(lcols_s2, rcols_s2, lemit)
+    rkb = rebits(rcols_s2, lcols_s2, remit)
     ldat = tuple(shard.pin(c.data, ctx) for c in lcols_s)
     lval = tuple(shard.pin(c.valid_mask(), ctx) for c in lcols_s)
     rdat = tuple(shard.pin(c.data, ctx) for c in rcols_s)
@@ -1302,23 +1403,20 @@ def _groupby_shuffle_agg(ctx: CylonContext, key_columns, value_columns,
     (key_out_cols, agg list of (arr, valid), gvalid)."""
     with _phase("distributed_groupby.shuffle", seq):
         view = Table(list(key_columns) + list(value_columns), ctx, None)
-        extra = {}
-        nbits = 0
-        h1s = []
-        for c in key_columns:
-            b, h1 = _dist_col_keys(ctx, c)
-            h1s.append(h1)
-            for arr in b:
-                extra[f"kb{nbits}"] = arr
-                nbits += 1
-        targets = shard.pin(_targets_from_hashes(ctx, h1s), ctx)
-        out_cols, emit_s, xout = _exchange_table(view, targets, emit, ctx,
-                                                 extra)
+        targets = shard.pin(
+            _partition_targets_dist(ctx, key_columns), ctx)
+        out_cols, emit_s, _x = _exchange_table(view, targets, emit, ctx)
 
     nk = len(key_columns)
     kcols_s = out_cols[:nk]
     vcols_s = out_cols[nk:]
-    kbits = tuple(xout[f"kb{j}"] for j in range(nbits))
+    # key bits recompute per shard from the shuffled key columns —
+    # recomputable lanes never cross the exchange (round-4 review)
+    kbits = []
+    for c in kcols_s:
+        b, _h1 = _dist_col_keys(ctx, c)
+        kbits.extend(b)
+    kbits = tuple(shard.pin(b, ctx) for b in kbits)
     kdat = tuple(shard.pin(c.data, ctx) for c in kcols_s)
     kval = tuple(shard.pin(c.valid_mask(), ctx) for c in kcols_s)
     vdat = tuple(shard.pin(c.data, ctx) for c in vcols_s)
@@ -1480,45 +1578,95 @@ RING_SKEW_FACTOR = 4
 
 
 @lru_cache(maxsize=None)
-def _shard_sort_fn(mesh, nd: int, nv: int):
-    """Per-shard fused sort by (dead-last, key bits): every payload
+def _shard_sort_fn(mesh, nd: int, nv: int, nk: int = 1):
+    """Per-shard fused sort by (dead-last, key lanes…): every payload
     column rides as a sort operand; returns sorted dat/val/emit plus the
-    permutation (for varbytes content takes)."""
+    permutation (for varbytes content takes). ``nk``: number of key
+    lanes (multi-key / varbytes-prefix sorts pass several)."""
     spec = P(mesh.axis_names[0])
 
     def kernel(bits, emit, dat, val):
-        n = bits.shape[0]
+        n = bits[0].shape[0]
         dead = (~emit).astype(jnp.uint8)
         iota = jnp.arange(n, dtype=jnp.int32)
-        ops = (dead, bits) + tuple(dat) + tuple(val) + (emit, iota)
-        res = jax.lax.sort(ops, num_keys=2, is_stable=True)
-        return (res[2:2 + nd], res[2 + nd:2 + nd + nv], res[-2], res[-1])
+        ops = (dead,) + tuple(bits) + tuple(dat) + tuple(val) + (emit, iota)
+        res = jax.lax.sort(ops, num_keys=1 + nk, is_stable=True)
+        o = 1 + nk
+        return (res[o:o + nd], res[o + nd:o + nd + nv], res[-2], res[-1])
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 4,
                              out_specs=spec))
 
 
-def _range_splitters(ctx: CylonContext, bits, emit):
-    """Host-side splitter agreement: gather a small random key sample,
-    keep live rows, take world-1 quantiles. Deterministic seed keeps
-    every controller process agreeing (multi-host: same computation on
-    the replicated sample)."""
+def _range_splitters(ctx: CylonContext, lanes, emit):
+    """Host-side splitter agreement over COMPOSITE keys: gather a small
+    random sample of every key lane, keep live rows, take world-1
+    lexicographic quantiles. Deterministic seed keeps every controller
+    process agreeing (multi-host: same computation on the replicated
+    sample). Returns a list of world-1 key TUPLES."""
     world = ctx.get_world_size()
-    n = int(bits.shape[0])
+    n = int(lanes[0].shape[0])
     rng = np.random.default_rng(0xC11)
     k = min(n, SORT_SAMPLES_PER_SHARD * world)
     pos = jnp.asarray(np.sort(rng.integers(0, n, k)).astype(np.int32))
-    sample = np.asarray(jax.device_get(jnp.take(bits, pos)))
+    samples = [np.asarray(jax.device_get(jnp.take(l, pos))) for l in lanes]
     live = np.asarray(jax.device_get(jnp.take(emit, pos)))
-    sample = np.sort(sample[live])
-    if sample.size == 0:
-        return np.zeros(world - 1, dtype=np.asarray(
-            jax.device_get(bits[:1])).dtype)
-    q = (np.arange(1, world) * sample.size) // world
-    return sample[q]
+    samples = [s[live] for s in samples]
+    if samples[0].size == 0:
+        return [tuple(s.dtype.type(0) for s in samples)] * (world - 1)
+    order = np.lexsort(tuple(reversed(samples)))
+    q = (np.arange(1, world) * samples[0].size) // world
+    return [tuple(s[order[qi]] for s in samples) for qi in q]
+
+
+def _splitter_targets(lanes, splitters):
+    """target = #splitter-tuples lexicographically <= the row's key
+    tuple: (world-1) * n_lanes vector compares, no searchsorted."""
+    targets = jnp.zeros(lanes[0].shape[0], jnp.int32)
+    for tup in splitters:
+        ge = jnp.zeros(lanes[0].shape[0], bool)
+        eq = jnp.ones(lanes[0].shape[0], bool)
+        for lane, sv in zip(lanes, tup):
+            v = jnp.asarray(sv)
+            ge = ge | (eq & (lane > v))
+            eq = eq & (lane == v)
+        targets = targets + (ge | eq).astype(jnp.int32)
+    return targets
+
+
+def _dist_order_lanes(ctx: CylonContext, c: Column, a: bool):
+    """Bit lanes whose lexicographic tuple order equals column c's sort
+    order (ascending=a, nulls last) — the distributed analog of
+    table._sort_keys_mixed. Varbytes columns use per-shard big-endian
+    prefix word lanes + length (exact up to SORT_PREFIX_WORDS*4 bytes;
+    beyond that returns None → host path). Reference: sort kernels incl.
+    strings, arrow_kernels.cpp:136-317."""
+    if c.is_varbytes:
+        from ..data.strings import SORT_PREFIX_WORDS, _bswap32
+
+        vb = c.varbytes
+        if not vb.sortable_on_device:
+            return None
+        k_lim = min(vb.max_words, SORT_PREFIX_WORDS)
+        lanes = [_bswap32(l) for l in _dist_word_lanes(ctx, c, k_lim)]
+        lanes.append(vb.lengths.astype(jnp.uint32))
+        if not a:
+            lanes = [l ^ jnp.uint32(0xFFFFFFFF) for l in lanes]
+        if c.validity is not None:
+            ext = jnp.uint32(0xFFFFFFFF)
+            lanes = [jnp.where(c.validity, l, ext) for l in lanes]
+        return lanes
+    return list(_order.sort_keys([c], [a]))
 
 
 def distributed_sort(table: Table, order_by, ascending=True) -> Table:
+    """Splitter-based distributed sort over ANY key combination: sample
+    composite key-lane tuples, agree range splitters, range-partition
+    through the same exchange the joins use, per-shard fused sort. No
+    global gather for multi-key or (short) varbytes ORDER columns; rows
+    beyond the device prefix bound (> SORT_PREFIX_WORDS*4-byte strings)
+    take the host path. Reference: Sort + sort kernels incl. strings
+    (table.hpp:365, arrow_kernels.cpp:136-317)."""
     ctx = table._ctx
     t = shard.distribute(table, ctx) if ctx.is_distributed() else table
     by = order_by if isinstance(order_by, (list, tuple)) else [order_by]
@@ -1528,28 +1676,35 @@ def distributed_sort(table: Table, order_by, ascending=True) -> Table:
     world = ctx.get_world_size()
     order_cols = [t._columns[i] for i in idxs]
 
-    splitter_ok = (ctx.is_distributed() and world > 1
-                   and len(idxs) == 1 and not order_cols[0].is_varbytes)
-    if not splitter_ok:
-        return _global_sort_fallback(ctx, t, idxs, asc, order_cols)
+    if not (ctx.is_distributed() and world > 1):
+        return t.sort(by, ascending)
+
+    per_col = [_dist_order_lanes(ctx, c, a)
+               for c, a in zip(order_cols, asc)]
+    if any(l is None for l in per_col):
+        # >SORT_PREFIX_WORDS varbytes keys: host sort of the SORT
+        # columns only, then redistribute (the reference's string sort
+        # is a host-memory Arrow kernel too, arrow_kernels.cpp:136-230)
+        return shard.distribute(t.compact().sort(by, ascending), ctx)
+    lanes = [l for col_lanes in per_col for l in col_lanes]
 
     seq = ctx.get_next_sequence()
     with _phase("distributed_sort.partition", seq):
-        bits = shard.pin(_order.sort_keys(order_cols, asc)[0], ctx)
+        lanes = [shard.pin(l, ctx) for l in lanes]
         emit = shard.pin(t.emit_mask(), ctx)
-        splitters = _range_splitters(ctx, bits, emit)
-        # target = #splitters <= key: W-1 vector compares, no search
-        targets = jnp.zeros(bits.shape[0], jnp.int32)
-        for s in splitters:
-            targets = targets + (bits >= jnp.asarray(s)).astype(jnp.int32)
+        splitters = _range_splitters(ctx, lanes, emit)
+        targets = _splitter_targets(lanes, splitters)
+        extra = {f"sb{i}": l for i, l in enumerate(lanes)}
         cols_s, emit_s, xout = _exchange_table(
-            t, shard.pin(targets, ctx), emit, ctx, {"sb": bits})
+            t, shard.pin(targets, ctx), emit, ctx, extra)
 
     with _phase("distributed_sort.local", seq):
         dat = tuple(shard.pin(c.data, ctx) for c in cols_s)
         val = tuple(shard.pin(c.valid_mask(), ctx) for c in cols_s)
+        sbits = tuple(xout[f"sb{i}"] for i in range(len(lanes)))
         sdat, sval, semit, perm = _shard_sort_fn(
-            ctx.mesh, len(dat), len(val))(xout["sb"], emit_s, dat, val)
+            ctx.mesh, len(dat), len(val), len(lanes))(
+            sbits, emit_s, dat, val)
     out_cols = []
     for d, v, c in zip(sdat, sval, cols_s):
         if c.is_varbytes:
@@ -1561,29 +1716,3 @@ def distributed_sort(table: Table, order_by, ascending=True) -> Table:
     return Table(out_cols, ctx, semit)
 
 
-def _global_sort_fallback(ctx, t, idxs, asc, order_cols):
-    """XLA global sort (multi-key / varbytes order columns / local)."""
-    if any(c.is_varbytes for c in order_cols):
-        raise CylonError(
-            Code.NotImplemented,
-            "distributed_sort on a varbytes ORDER column needs device "
-            "prefix-key splitters; dictionary-encode the sort column")
-    with _phase("distributed_sort", ctx.get_next_sequence()):
-        keys = _order.sort_keys(order_cols, asc)
-        emit = t.emit_mask()
-        # live rows first, padding at the tail
-        dead_last = (~emit).astype(jnp.uint8)
-        perm = _order.lexsort_indices([dead_last] + keys)
-        cols = []
-        for c in t._columns:
-            g = c.take(perm)
-            if g.is_varbytes:
-                # eager varlen gather produced an unsharded layout; keep
-                # it intact (content lives in g.varbytes, not g.data)
-                cols.append(g)
-                continue
-            validity = None if g.validity is None \
-                else shard.pin(g.validity, ctx)
-            cols.append(Column(shard.pin(g.data, ctx), g.dtype, validity,
-                               g.dictionary, g.name))
-        return Table(cols, ctx, shard.pin(jnp.take(emit, perm), ctx))

@@ -293,8 +293,9 @@ def count_pair(targets1, emit1, targets2, emit2, ctx: CylonContext):
     """Host (countsL, countsR) for two shuffles, one program + one sync.
     Feed the results to exchange(..., counts=...)."""
     # result is [src, 2, dst] (replicated_gather stacks per source)
-    both = np.asarray(jax.device_get(
-        _count2_fn(ctx.mesh)(targets1, emit1, targets2, emit2)))
+    with _phase("shuffle.count", ctx.get_next_sequence()):
+        both = np.asarray(jax.device_get(
+            _count2_fn(ctx.mesh)(targets1, emit1, targets2, emit2)))
     return both[:, 0, :], both[:, 1, :]
 
 

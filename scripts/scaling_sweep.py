@@ -1,0 +1,114 @@
+"""Scaling-shape sweep (VERDICT r03 #8; reference analog:
+cpp/src/experiments/run_dist_scaling.py:1-60, which sweeps MPI world
+sizes 1-160 with weak/strong scaling vs Dask/Spark).
+
+Here the mesh is W virtual CPU devices in one process (the same
+simulation the test matrix uses), swept over world sizes {1,2,4,8} for
+the distributed inner join and the raw exchange. Wall-clock on the CPU
+backend is NOT TPU performance — the artifact captures the SCALING
+SHAPE (how exchange volume and join time grow with W at fixed global
+rows, and per-shard behavior at fixed shard rows), which is
+mesh-topology math independent of the backend.
+
+Usage: python scripts/scaling_sweep.py [rows_log2=20]
+Writes SCALING.json at the repo root.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def probe(x):
+    jax.device_get(jax.tree.leaves(x)[0].reshape(-1)[:1])
+
+
+def best_of(f, iters=3):
+    f()
+    b = 1e9
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f()
+        b = min(b, time.perf_counter() - t0)
+    return b
+
+
+def sweep_world(world: int, n: int) -> dict:
+    import cylon_tpu as ct
+    from cylon_tpu.ops import hash as _hash
+    from cylon_tpu.parallel import shard as _shard
+    from cylon_tpu.parallel import shuffle as _shuffle
+    from cylon_tpu.parallel import dist_ops as D
+
+    ctx = ct.CylonContext.InitDistributed(ct.TPUConfig(world_size=world))
+    rng = np.random.default_rng(world)
+    left = _shard.distribute(ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n, n).astype(np.int64),
+        "v": rng.normal(size=n).astype(np.float32)}), ctx)
+    right = _shard.distribute(ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n, n).astype(np.int64),
+        "w": rng.normal(size=n).astype(np.float32)}), ctx)
+
+    targets = _shard.pin(
+        _hash.partition_targets([left.get_column(0)], world), ctx)
+    emit = _shard.pin(left.emit_mask(), ctx)
+    payload = {"k": _shard.pin(left.get_column(0).data, ctx),
+               "v": _shard.pin(left.get_column(1).data, ctx)}
+
+    def ex():
+        out, _e, _c, _m = _shuffle.exchange(payload, targets, emit, ctx)
+        probe(out)
+
+    t_ex = best_of(ex)
+    row_bytes = sum(int(np.dtype(np.asarray(v).dtype).itemsize)
+                    for v in payload.values())
+
+    cfg = left._make_join_config(right, "inner", "sort", {"on": ["k"]})
+
+    def dj():
+        out = D.distributed_join(left, right, cfg, force_exchange=True)
+        probe(out.get_column(0).data)
+
+    t_join = best_of(dj, iters=2)
+
+    return {
+        "world": world,
+        "global_rows": n,
+        "exchange_s": round(t_ex, 4),
+        "exchange_gb_per_s": round(n * row_bytes / t_ex / 1e9, 4),
+        "dist_join_s": round(t_join, 4),
+        "dist_join_rows_per_s": round(2 * n / t_join, 1),
+    }
+
+
+def main(log2n: int) -> dict:
+    n = 1 << log2n
+    res = {"backend": "cpu-virtual-mesh", "mode": "strong-scaling",
+           "global_rows": n, "worlds": []}
+    for w in (1, 2, 4, 8):
+        r = sweep_world(w, n)
+        res["worlds"].append(r)
+        print(json.dumps(r), flush=True)
+    base = res["worlds"][0]["dist_join_s"]
+    for r in res["worlds"]:
+        r["join_speedup_vs_w1"] = round(base / r["dist_join_s"], 3)
+    return res
+
+
+if __name__ == "__main__":
+    out = main(int(sys.argv[1]) if len(sys.argv) > 1 else 20)
+    print(json.dumps(out))
+    with open(os.path.join(os.path.dirname(__file__), "..",
+                           "SCALING.json"), "w") as f:
+        json.dump(out, f, indent=1)

@@ -7,20 +7,27 @@ virtual devices, and distributed tests run in ONE pytest process.
 """
 import os
 
-# Must be set before jax initializes its backends. Force CPU: the test
-# matrix simulates the mesh with virtual host devices even when a real TPU
-# is attached (the driver benches on the real chip separately).
-os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
-    " --xla_force_host_platform_device_count=8"
-os.environ["JAX_PLATFORMS"] = "cpu"
+# CYLON_TPU_TESTS=1 keeps the REAL backend (the `tpu` marker's compiled
+# Pallas correctness tests, scripts/run_tpu_tests.sh); the default matrix
+# forces CPU and simulates the mesh with virtual host devices.
+TPU_MODE = os.environ.get("CYLON_TPU_TESTS") == "1"
+
+if not TPU_MODE:
+    # Must be set before jax initializes its backends.
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-# jax may already be imported by a pytest plugin before this conftest runs,
-# in which case the env vars above were read too late — set via config too.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
-jax.config.update("jax_enable_x64", True)
+if not TPU_MODE:
+    # jax may already be imported by a pytest plugin before this conftest
+    # runs, in which case the env vars above were read too late — set via
+    # config too.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    # x64 stays OFF in TPU mode (Mosaic rejects 64-bit converts)
+    jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,70 @@
+"""arrow_builder protocol (reference: arrow/arrow_builder.cpp:31-161):
+Begin/AddColumn(buffer addresses)/FinishTable into the table_api
+registry — the bindings-facing raw-buffer ingest path."""
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import arrow_builder, table_api
+from cylon_tpu.dtypes import Type
+
+
+def _addr(arr: np.ndarray):
+    return arr.ctypes.data, arr.nbytes
+
+
+def test_build_table_from_raw_buffers():
+    tid = "bld-1"
+    arrow_builder.begin_table(tid)
+
+    ints = np.array([10, 20, 30, 40, 50], np.int64)
+    a, s = _addr(ints)
+    arrow_builder.add_column(tid, "x", int(Type.INT64), 5, 0, 0, 0, a, s)
+
+    floats = np.array([1.5, 2.5, 3.5, 4.5, 5.5], np.float64)
+    # validity bitmap: rows 0,2,3,4 valid (row 1 null), LSB order
+    bitmap = np.array([0b00011101], np.uint8)
+    va, vs = _addr(bitmap)
+    fa, fs = _addr(floats)
+    arrow_builder.add_column(tid, "y", int(Type.DOUBLE), 5, 1,
+                             va, vs, fa, fs)
+
+    # varlen string column: Arrow offsets + payload
+    payload = b"heyjudedont"
+    offsets = np.array([0, 3, 7, 7, 11, 11], np.int32)
+    pb = np.frombuffer(payload, np.uint8)
+    oa, osz = _addr(offsets)
+    pa, ps = _addr(pb)
+    arrow_builder.add_column(tid, "s", int(Type.STRING), 5, 0,
+                             0, 0, pa, ps, oa, osz)
+
+    arrow_builder.finish_table(tid)
+    t = table_api.get_table(tid)
+    d = t.to_pydict()
+    assert list(d["x"]) == [10, 20, 30, 40, 50]
+    ys = d["y"]
+    assert ys[1] is None or ys[1] != ys[1]
+    np.testing.assert_allclose([ys[0], ys[2], ys[3], ys[4]],
+                               [1.5, 3.5, 4.5, 5.5])
+    assert list(d["s"]) == ["hey", "jude", "", "dont", ""]
+    # registered table joins like any other
+    other = ct.Table.from_pydict(t.context, {"x": np.array([20, 40, 99])})
+    table_api.put_table("bld-2", other)
+    table_api.join_tables(tid, "bld-2", ct.JoinConfig.InnerJoin(0, 0),
+                          "bld-out")
+    assert table_api.get_table("bld-out").row_count == 2
+    for i in (tid, "bld-2", "bld-out"):
+        table_api.remove_table(i)
+
+
+def test_builder_errors():
+    with pytest.raises(Exception):
+        arrow_builder.add_column("nope", "c", int(Type.INT32), 0, 0,
+                                 0, 0, 0, 0)
+    with pytest.raises(Exception):
+        arrow_builder.finish_table("nope")
+    arrow_builder.begin_table("dup")
+    with pytest.raises(Exception):
+        arrow_builder.begin_table("dup")
+    arrow_builder.finish_table("dup")
+    table_api.remove_table("dup")
