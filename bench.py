@@ -169,6 +169,46 @@ def bench_shuffle(ctx, n_rows: int, iters: int) -> dict:
             "wall_s_best": round(best, 4)}
 
 
+def bench_shuffle_wide(ctx, n_rows: int, iters: int) -> dict:
+    """Bandwidth-oriented shuffle config: 8 payload leaves (40 B/row —
+    a realistic wide table). The narrow config's GB/s is dominated by
+    the per-exchange fixed cost (bucket sort of the key + ~0.1 s tunnel
+    sync, see PROFILE_shuffle.json); payload leaves ride the sort at
+    near-memcpy cost, so bandwidth scales with row width."""
+    import jax
+    import jax.numpy as jnp
+
+    from cylon_tpu.parallel import shard as _shard
+    from cylon_tpu.parallel.shuffle import exchange
+
+    rng = np.random.default_rng(8)
+    world = max(ctx.get_world_size(), 1)
+    payload = {}
+    bytes_per_row = 0
+    for i in range(6):
+        payload[f"f{i}"] = _shard.pin(jnp.asarray(
+            rng.normal(size=n_rows).astype(np.float32)), ctx)
+        bytes_per_row += 4
+    for i in range(2):
+        payload[f"i{i}"] = _shard.pin(jnp.asarray(
+            rng.integers(0, 1 << 31, n_rows).astype(np.int64)), ctx)
+        bytes_per_row += 8
+    targets = _shard.pin(jnp.asarray(
+        rng.integers(0, world, n_rows).astype(np.int32)), ctx)
+    emit = _shard.pin(jnp.ones(n_rows, dtype=bool), ctx)
+
+    def one():
+        out, new_emit, _cap, _meta = exchange(payload, targets, emit, ctx)
+        jax.device_get(out["f0"][:1])
+
+    best = _time(one, iters)
+    gbps = n_rows * bytes_per_row / best / 1e9 / world
+    return {"gbps_per_chip": round(gbps, 3),
+            "bytes_per_row": bytes_per_row,
+            "rows_per_s_per_chip": n_rows / best / world,
+            "wall_s_best": round(best, 4)}
+
+
 def bench_groupby(ctx, n_rows: int, iters: int) -> dict:
     import cylon_tpu as ct
 
@@ -286,8 +326,9 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
         suite["set_union"] = bench_setops(ctx, n_rows // 2, iters)
         suite["q5_pipeline"] = bench_q5_pipeline(ctx, n_rows // 2, iters)
         suite["string_join"] = bench_string_join(ctx, n_rows // 4, iters)
+        suite["shuffle_wide"] = bench_shuffle_wide(ctx, n_rows, iters)
         suite["hbm_blocked_join"] = bench_hbm_blocked_join(
-            ctx, n_rows * 16, n_rows * 4)
+            ctx, n_rows * 12, n_rows * 3)
     rps = dist_res["rows_per_s_per_chip"]
     return {
         "metric": "dist_inner_join_rows_per_sec_per_chip",

@@ -91,10 +91,15 @@ class Column:
                     vb, _dev_mask(validity if not validity.all() else None),
                     name, dtypes.Binary())
             if len(seen) > thresh:
+                # bailing early: later chunks may still hold BINARY
+                # values — their scan is negligible next to from_host's
+                # own full pass on this (varbytes) path
+                is_bin = any(isinstance(v, bytes)
+                             for v in safe[lo + (1 << 16):])
                 vb = VarBytes.from_host(safe)
                 return Column.from_varbytes(
                     vb, _dev_mask(validity if not validity.all() else None),
-                    name)
+                    name, dtypes.Binary() if is_bin else None)
         vocab, codes = np.unique(safe.astype(str), return_inverse=True)
         col = Column(jnp.asarray(codes.astype(np.int32)), dtypes.String(),
                      _dev_mask(validity if not validity.all() else None),

@@ -856,7 +856,8 @@ def _join_once(left: Table, right: Table, config: _join.JoinConfig) -> Table:
                                              _join.JoinType.LEFT)):
         sub = _join.JoinConfig(_join.JoinType.LEFT,
                                config.left_column_idx,
-                               config.right_column_idx, alg)
+                               config.right_column_idx, alg,
+                               exact=config.exact)
         out = _join_once(left, right, sub)
         return _append_unmatched_right(left, right, config, out,
                                        aligned=(lcols, rcols))
@@ -1190,12 +1191,6 @@ def groupby_local(table: Table, index_col, aggregate_cols: List,
                 "varbytes value columns support COUNT only (MIN/MAX need "
                 "a total order the content-hash identity does not carry; "
                 "dictionary-encode the column for string MIN/MAX)")
-    # streaming Pallas path (opt-in: measured slower than the XLA
-    # segment path on v5e — see ops/groupby.py block comment)
-    out = _groupby.stream_groupby_table(table, idx_cols, val_cols, ops)
-    if out is not None:
-        return out
-
     key_columns = [table._columns[i] for i in idx_cols]
     keys = []
     for c in key_columns:

@@ -11,7 +11,15 @@ buffers from the pool, arrow_all_to_all.cpp:234-247).
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional
+
+# HBM per chip when the runtime hides memory_stats (tunneled backends —
+# the axon platform returns None): v5e carries 16 GiB. Overridable via
+# CYLON_HBM_BYTES. Without this fallback the >HBM routing guards
+# (join_blocked auto-engage, shuffle comm budget) silently disarm and a
+# beyond-memory join OOMs instead of chunking.
+DEFAULT_TPU_HBM_BYTES = 16 * (1 << 30)
 
 
 class MemoryPool:
@@ -24,6 +32,12 @@ class MemoryPool:
         self._devices = [d for d in devices
                          if _stats(d) is not None]
         self.comm_fraction = comm_fraction
+        self._fallback_limit = None
+        if not self._devices and any(
+                getattr(d, "platform", "") in ("tpu", "axon")
+                for d in devices):
+            self._fallback_limit = int(os.environ.get(
+                "CYLON_HBM_BYTES", DEFAULT_TPU_HBM_BYTES))
 
     def bytes_allocated(self) -> int:
         """Live HBM across local mesh devices (0 when the backend does not
@@ -40,7 +54,10 @@ class MemoryPool:
                    for d in self._devices if (s := _stats(d)) is not None)
 
     def available_bytes(self) -> Optional[int]:
-        """Free HBM on the tightest local device; None when unknown."""
+        """Free HBM on the tightest local device; the static chip limit
+        when the backend hides stats (live usage unknowable there, so
+        routing guards compare against the full chip); None when not a
+        TPU at all."""
         per = []
         for d in self._devices:
             s = _stats(d)
@@ -49,7 +66,9 @@ class MemoryPool:
             limit, used = s.get("bytes_limit"), s.get("bytes_in_use")
             if limit:
                 per.append(limit - (used or 0))
-        return min(per) if per else None
+        if per:
+            return min(per)
+        return self._fallback_limit
 
     def comm_budget_bytes(self) -> Optional[int]:
         """Per-device byte budget for in-flight shuffle buffers."""

@@ -124,218 +124,13 @@ def segment_aggregate(gid, values: Tuple[jnp.ndarray, ...],
 
 
 # ---------------------------------------------------------------------------
-# Streaming groupby path: ONE fused sort + ONE Pallas pass
-# (tpu_kernels.groupby_stream) replacing dense-ranks + XLA segment
-# reductions. Exact: runs are delimited by the TRUE key bits
-# (multi-operand compare) for up to MAX_GROUP_KEY_LANES lanes; wider
-# keys use 2x32 hash operands with verify lanes and an exact fallback
-# on any collision.
-#
-# MEASURED (v5e, honest device_get timing, 16M rows): the kernel runs
-# 10-11M rows/s vs the XLA segment path's 13-19M across 1K-1M group
-# cardinalities and 1-3 aggregates — the segmented scans (3+ log-shift
-# passes per block) cost more than the scatter they remove, unlike the
-# join/setops kernels where ONE pass replaced several scatter chains.
-# It is therefore OFF by default (STREAM_GROUPBY=True forces it; the
-# interpreter test suite exercises it for correctness) and kept as
-# tuned-kernel groundwork.
+# A Pallas streaming groupby (ONE fused sort + ONE segmented-scan pass)
+# was built and benchmarked in rounds 2-3: 10-11M rows/s vs the XLA
+# segment path's 13-19M across 1K-1M group cardinalities on v5e — the
+# segmented scans (3+ log-shift passes per block) cost more than the
+# scatter they remove, unlike the join/setops kernels where one pass
+# replaced several scatter chains. Per the round-3 review it was
+# REMOVED rather than shipped as a slower parallel implementation
+# (git history: rounds 2-3 carry the kernel and its tests).
 # ---------------------------------------------------------------------------
 
-# True forces the streaming path; None/False use the XLA segment path
-# (measured faster — see block comment)
-STREAM_GROUPBY = None
-
-# kernel block-rows override (None = stream_block_rows policy; BR=16
-# measured best of {16,32,64,128,256} on v5e)
-BLOCK_ROWS_OVERRIDE = None
-
-MAX_GROUP_KEY_LANES = 4
-MAX_HASH_VERIFY_LANES = 8
-
-_KIND = {"float32": "f", "int32": "i", "uint32": "u"}
-
-
-def _key_lanes(col):
-    """(lanes, nullable): u32 equality lanes for one key column. Null
-    rows are normalized to shared extreme bits (their raw data is
-    arbitrary filler), with the validity lane separating them from
-    genuine extreme values — the sort_keys null discipline."""
-    import jax.numpy as jnp
-
-    from .order import sort_keys
-
-    if col.is_varbytes:
-        from ..data.strings import EXACT_KEY_WORDS
-
-        vb = col.varbytes
-        if vb.max_words <= EXACT_KEY_WORDS:
-            # byte-exact group identity: raw word lanes + length
-            return (vb.word_lanes() + [vb.lengths.astype(jnp.uint32)],
-                    col.validity is not None)
-        # hash of the "" filler is shared by all nulls; the validity
-        # lane (added by the caller) splits them from genuine ""
-        return list(vb.hash_keys()), col.validity is not None
-    bits = sort_keys([col])[0]
-    w = bits.dtype.itemsize
-    if w == 8:
-        return [(bits >> 32).astype(jnp.uint32), bits.astype(jnp.uint32)], \
-            col.validity is not None
-    return [bits.astype(jnp.uint32) if w < 4 else
-            (bits if bits.dtype == jnp.uint32 else bits.view(jnp.uint32))], \
-        col.validity is not None
-
-
-def stream_groupby_table(table, idx_cols, val_cols, ops):
-    """Try the streaming groupby; returns the result Table or None
-    (inapplicable / hash collision — caller uses the XLA path)."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from .. import dtypes as _dtypes
-    from ..data.column import Column
-    from ..data.table import Table, _agg_dtype
-    from ..util import capacity as _capacity
-    from . import hash as _hash_mod
-    from . import tpu_kernels as tk
-
-    n = table.capacity
-    if STREAM_GROUPBY is not True or n == 0 or n >= (1 << 29):
-        return None
-    interpret = jax.default_backend() != "tpu"
-
-    # value lanes: 4-byte numerics only (others fall back)
-    val_cols_u = sorted(set(val_cols))
-    kinds = {}
-    for vi in val_cols_u:
-        c = table._columns[vi]
-        if c.is_string or c.data.ndim != 1:
-            return None
-        kind = _KIND.get(str(c.data.dtype))
-        if kind is None:
-            return None
-        kinds[vi] = kind
-    for vi, op in zip(val_cols, ops):
-        if op == AggregationOp.MEAN and kinds[vi] != "f":
-            # MEAN sums in the source lane dtype here; an int32 sum wraps
-            # before the division (the XLA path accumulates in f64) —
-            # integer MEAN falls back
-            return None
-
-    # key lanes (exact multi-operand mode, or hash mode when wide)
-    klanes = []
-    for i in idx_cols:
-        lanes, nullable = _key_lanes(table._columns[i])
-        klanes.extend(lanes)
-        if nullable:
-            klanes.append(
-                table._columns[i].valid_mask().astype(jnp.uint32))
-    hash_mode = len(klanes) > MAX_GROUP_KEY_LANES
-    if hash_mode and len(klanes) > MAX_HASH_VERIFY_LANES:
-        return None
-
-    emit = table.emit_mask()
-    iota = jnp.arange(n, dtype=jnp.uint32)
-    tag = (emit.astype(jnp.uint32) << 29) | iota
-
-    # specs: one scan per distinct (col, op) pair; COUNT/MEAN ride vcnt
-    lane_of = {vi: k for k, vi in enumerate(val_cols_u)}
-    spec_ix = {}
-    specs = []
-    for vi, op in zip(val_cols, ops):
-        o = AggregationOp.SUM if op == AggregationOp.MEAN else op
-        if o == AggregationOp.COUNT:
-            continue
-        key = (lane_of[vi], int(o))
-        if key not in spec_ix:
-            spec_ix[key] = len(specs)
-            specs.append((lane_of[vi], int(o), kinds[vi]))
-
-    val_lanes = []
-    valid_lanes = []
-    vvalid_idx = []
-    for vi in val_cols_u:
-        c = table._columns[vi]
-        d = c.data
-        val_lanes.append(d if d.dtype == jnp.uint32 else d.view(jnp.uint32))
-        if c.validity is not None:
-            vvalid_idx.append(len(valid_lanes))
-            valid_lanes.append(c.validity.astype(jnp.uint32))
-        else:
-            vvalid_idx.append(-1)
-
-    from .join import stream_block_rows
-
-    # the groupby pass is standalone (no expand-window coupling like the
-    # join kernels); BLOCK_ROWS_OVERRIDE exists for tuning experiments
-    br = BLOCK_ROWS_OVERRIDE or stream_block_rows(n, 0)
-    allones = jnp.uint32(0xFFFFFFFF)
-    if hash_mode:
-        # dead rows to the tail (pad fill is allones+live=0; forcing dead
-        # hashes to allones groups them with the pad run harmlessly —
-        # contributions are identity for dead rows)
-        h1, h2 = _hash_mod.hash2_streams(klanes, emit)
-        ops_in = (h1, h2, tag) + tuple(klanes) + tuple(val_lanes) \
-            + tuple(valid_lanes)
-        res = jax.lax.sort(ops_in, num_keys=2)
-        keys_s = list(res[:2])
-        tag_s = res[2]
-        nv = len(klanes)
-        verify_s = list(res[3:3 + nv])
-        vals_s = list(res[3 + nv:3 + nv + len(val_lanes)])
-        valids_s = list(res[3 + nv + len(val_lanes):])
-    else:
-        klanes_d = [jnp.where(emit, kl, allones) for kl in klanes]
-        ops_in = tuple(klanes_d) + (tag,) + tuple(val_lanes) \
-            + tuple(valid_lanes)
-        res = jax.lax.sort(ops_in, num_keys=len(klanes))
-        keys_s = list(res[:len(klanes)])
-        tag_s = res[len(klanes)]
-        verify_s = []
-        vals_s = list(res[len(klanes) + 1:len(klanes) + 1 + len(val_lanes)])
-        valids_s = list(res[len(klanes) + 1 + len(val_lanes):])
-
-    counts, outs = tk.groupby_stream(
-        keys_s, tag_s, verify_s, vals_s, valids_s, tuple(specs),
-        tuple(vvalid_idx), block_rows=br, interpret=interpret)
-    host = jax.device_get(counts)
-    ng, ncoll = int(host[0]), int(host[1])
-    if ncoll > 0:
-        return None
-    ncols_u = len(val_cols_u)
-    cap = min(_capacity(max(ng, 1)), outs[0].size)
-    emit_out = jnp.arange(cap, dtype=jnp.int32) < ng
-    rep = jnp.where(emit_out,
-                    outs[0].reshape(-1)[:cap].view(jnp.int32), 0)
-    vcnts = {vi: outs[1 + k].reshape(-1)[:cap].view(jnp.int32)
-             for k, vi in enumerate(val_cols_u)}
-    aggs = {k: outs[1 + ncols_u + six].reshape(-1)[:cap]
-            for k, six in spec_ix.items()}
-
-    out_cols = []
-    for i in idx_cols:
-        g = table._columns[i].take(rep)
-        validity = None if g.validity is None else g.validity & emit_out
-        out_cols.append(Column(g.data, g.dtype, validity, g.dictionary,
-                               g.name, varbytes=g.varbytes))
-    for vi, op in zip(val_cols, ops):
-        src = table._columns[vi]
-        vcnt = vcnts[vi]
-        if op == AggregationOp.COUNT:
-            out_cols.append(Column(vcnt.astype(jnp.int64),
-                                   _agg_dtype(src, op), emit_out, None,
-                                   src.name))
-            continue
-        o = AggregationOp.SUM if op == AggregationOp.MEAN else op
-        raw = aggs[(lane_of[vi], int(o))]
-        data = raw if src.data.dtype == jnp.uint32 \
-            else raw.view(src.data.dtype)
-        validity = (vcnt > 0) & emit_out
-        if op == AggregationOp.MEAN:
-            data = data.astype(jnp.float64) / jnp.maximum(vcnt, 1)
-            out_cols.append(Column(data, _agg_dtype(src, op), validity,
-                                   None, src.name))
-        else:
-            out_cols.append(Column(data, _agg_dtype(src, op), validity,
-                                   None, src.name))
-    return Table(out_cols, table._ctx, emit_out)

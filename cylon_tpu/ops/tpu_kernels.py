@@ -942,325 +942,8 @@ def _compact_streams(nstreams, BR, mask_ref, streams, out_refs, cnt_ref,
 # ---------------------------------------------------------------------------
 # groupby_stream — streaming grouped aggregation
 # ---------------------------------------------------------------------------
-
-
-def flat_shift_down(x: jnp.ndarray, k: int, fill, interpret: bool = False
-                    ) -> jnp.ndarray:
-    """Shift a (R,128) block DOWN (toward higher flat index) by STATIC k:
-    out[j] = x[j-k], vacated head gets ``fill`` (may be a traced scalar)."""
-    R = x.shape[0]
-    rows_k, q = divmod(k, LANES)
-    a = _roll(x, rows_k % R, 0, interpret)
-    if q:
-        b = _roll(x, (rows_k + 1) % R, 0, interpret)
-        ra = _roll(a, q, 1, interpret)
-        rb = _roll(b, q, 1, interpret)
-        lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-        shifted = jnp.where(lane >= q, ra, rb)
-    else:
-        shifted = a
-    fi = flat_iota(x.shape)
-    return jnp.where(fi >= k, shifted, fill)
-
-
-def seg_scan(v: jnp.ndarray, f: jnp.ndarray, op, identity,
-             interpret: bool = False):
-    """Inclusive SEGMENTED scan of a (R,128) block in flat order:
-    Hillis-Steele with boundary flags (f=1 at segment starts). Returns
-    (scanned v, f_acc) where f_acc[j] = any boundary in [0..j] — ~f_acc
-    marks elements of the block's first (carry-absorbing) segment."""
-    span = v.shape[0] * LANES
-    k = 1
-    while k < span:
-        vs = flat_shift_down(v, k, identity, interpret)
-        fs = flat_shift_down(f, k, 0, interpret)
-        v = jnp.where(f != 0, v, op(vs, v))
-        f = f | fs
-        k <<= 1
-    return v, f
-
-
-# aggregation op codes shared with ops/groupby.AggregationOp
-_OP_SUM, _OP_MIN, _OP_MAX = 0, 2, 3
-
-
-def _bc(x, dt):
-    """bitcast_convert_type that skips the no-op case — Mosaic rejects
-    identity bitcasts (i32->i32) on TPU."""
-    return x if x.dtype == dt else jax.lax.bitcast_convert_type(x, dt)
-
-
-def _agg_arith(kind: str, op: int):
-    """(to_arith, combine, identity_bits, from_arith) for one agg lane.
-    kind: 'f' float32, 'i' int32, 'u' uint32 — all bit-packed in u32
-    sort lanes."""
-    if kind == "f":
-        conv = lambda u: _bc(u, jnp.float32)
-        back = lambda v: _bc(v, jnp.uint32)
-        ident = {_OP_SUM: jnp.float32(0.0),
-                 _OP_MIN: jnp.float32(jnp.inf),
-                 _OP_MAX: jnp.float32(-jnp.inf)}[op]
-    elif kind == "i":
-        conv = lambda u: _bc(u, jnp.int32)
-        back = lambda v: _bc(v, jnp.uint32)
-        ident = {_OP_SUM: jnp.int32(0),
-                 _OP_MIN: jnp.int32(jnp.iinfo(jnp.int32).max),
-                 _OP_MAX: jnp.int32(jnp.iinfo(jnp.int32).min)}[op]
-    elif op == _OP_SUM:
-        conv = lambda u: u
-        back = lambda v: v
-        ident = jnp.uint32(0)
-    else:
-        # unsigned MIN/MAX: Mosaic has no arith.minui/maxui — sign-flip
-        # into the ordered int32 domain
-        flip = jnp.uint32(0x80000000)
-        conv = lambda u: _bc(u ^ flip, jnp.int32)
-        back = lambda v: _bc(v, jnp.uint32) ^ flip
-        ident = jnp.int32(jnp.iinfo(jnp.int32).max) if op == _OP_MIN \
-            else jnp.int32(jnp.iinfo(jnp.int32).min)
-    comb = {_OP_SUM: jax.lax.add, _OP_MIN: jnp.minimum,
-            _OP_MAX: jnp.maximum}[op]
-    return conv, comb, ident, back
-
-
-def groupby_stream(keys_s: Sequence[jnp.ndarray], tag_s: jnp.ndarray,
-                   verify_s: Sequence[jnp.ndarray],
-                   vals_s: Sequence[jnp.ndarray],
-                   valids_s: Sequence[jnp.ndarray],
-                   specs: Tuple[Tuple[int, int, str], ...],
-                   vvalid_idx: Tuple[int, ...],
-                   block_rows: int = 64, interpret: bool = False):
-    """ONE sequential pass over the key-sorted stream that aggregates
-    every group and compacts (representative row, aggregates) — the
-    streaming replacement for dense-ranks + XLA segment reductions
-    (reference: LocalHashGroupBy, groupby_hash.hpp:143-246; the XLA path
-    it displaces is ops/groupby.segment_aggregate, whose scatter-class
-    segment_sum was the groupby bottleneck in BENCH_r02).
-
-    Inputs are sorted TOGETHER by keys_s (exact key bits + key-validity,
-    or a 2x32 row hash in hash mode): tag_s = ``live<<29 | iota``;
-    verify_s (hash mode) carry true key bits — any within-run mismatch
-    between LIVE neighbors increments the collision count and the caller
-    recomputes via the exact path. vals_s are u32-bit-packed value
-    lanes; valids_s are 0/1 validity lanes (see vvalid_idx).
-
-    specs: per output aggregate (value_lane_idx, op, kind) with op in
-    {0 SUM, 2 MIN, 3 MAX} (COUNT/MEAN derive from the vcnt streams
-    outside); vvalid_idx[vi] = index into valids_s or -1 (all-valid).
-
-    Cross-block state lives in u32 VMEM tail rows (Mosaic's tpu.bitcast
-    is vector-only, so scalar SMEM carries can't hold float bits): each
-    per-element running stream stores its block-final row; the next
-    block reads the last lane back as the shift fill / scan carry, with
-    (1,1) VECTOR bitcasts into the arithmetic domain.
-
-    The stream is padded with at least ONE dead element (all-ones keys,
-    live=0) so the final pending run always flushes at the last slot:
-    runs emit their totals when the NEXT run starts (the previous
-    element's running segmented scan IS the finished aggregate), and the
-    guaranteed-dead last element can never need a start-emission and a
-    flush at once.
-
-    Returns (counts i32[2] = [n_groups, n_collisions], out_streams):
-    out_streams = (rep_idx, vcnt_0..vcnt_{ncols-1}, agg per spec) —
-    compacted; vcnt = per-column live&valid count (0 => that aggregate
-    is null; also the COUNT value).
-    """
-    n = tag_s.shape[0]
-    BR = block_rows
-    nk = len(keys_s)
-    nver = len(verify_s)
-    ncols = len(vals_s)
-    nspec = len(specs)
-    nR = 1 + ncols + nspec + 1  # rep, vcnts, aggs, live_cnt
-    nO = 1 + ncols + nspec
-    assert BR % 8 == 0 and BR >= 8
-    assert n < (1 << 29)
-    # at least one trailing dead pad element (the flush guarantee)
-    blocks = max(-(-(n + 1) // (BR * LANES)), 1)
-    rows = blocks * BR
-    allones = jnp.uint32(0xFFFFFFFF)
-    k2 = [pad_rows(k, rows, fill=allones) for k in keys_s]
-    t2 = pad_rows(tag_s, rows, fill=0)
-    ver2 = [pad_rows(v, rows, fill=0) for v in verify_s]
-    v2 = [pad_rows(v, rows, fill=0) for v in vals_s]
-    va2 = [pad_rows(v, rows, fill=0) for v in valids_s]
-
-    out_rows = rows_for(n) + BR + 8
-    out_shapes = ([jax.ShapeDtypeStruct((out_rows, LANES), jnp.uint32)] * nO
-                  + [jax.ShapeDtypeStruct((2,), jnp.int32)])
-
-    # tail rows: [0, nO) compact-write partial rows, then prev-element
-    # carries: keys (nk), tag (1), verify (nver), running streams (nR)
-    t_key = nO
-    t_tag = t_key + nk
-    t_ver = t_tag + 1
-    t_run = t_ver + nver
-    n_tails = t_run + nR
-    nva = len(valids_s)
-    scratch = ([pltpu.SMEM((8,), jnp.int32),
-                pltpu.VMEM((n_tails, LANES), jnp.uint32)]
-               + [pltpu.VMEM((BR + 8, LANES), jnp.uint32)
-                  for _ in range(nO)]
-               + [pltpu.SemaphoreType.DMA((nO,))])
-
-    def kernel(*refs):
-        key_refs = refs[:nk]
-        tag_ref = refs[nk]
-        ver_refs = refs[nk + 1:nk + 1 + nver]
-        val_refs = refs[nk + 1 + nver:nk + 1 + nver + ncols]
-        vav_refs = refs[nk + 1 + nver + ncols:nk + 1 + nver + ncols + nva]
-        pos = nk + 1 + nver + ncols + nva
-        outs = refs[pos:pos + nO]
-        cnt_ref = refs[pos + nO]
-        carr = refs[pos + nO + 1]
-        tails = refs[pos + nO + 2]
-        bufs = list(refs[pos + nO + 3:pos + nO + 3 + nO])
-        sems = refs[pos + nO + 3 + nO]
-        i = pl.program_id(0)
-        last_block = pl.num_programs(0) - 1
-
-        @pl.when(i == 0)
-        def _():
-            carr[0] = 0
-            carr[1] = 0
-            tails[:] = jnp.zeros((n_tails, LANES), jnp.uint32)
-            # impossible prev-key rows: a boundary fires at element 0
-            for s in range(nk):
-                tails[t_key + s:t_key + s + 1, :] = jnp.full(
-                    (1, LANES), jnp.uint32(0xFFFFFFFE))
-
-        keys = [r[:] for r in key_refs]
-        tag = tag_ref[:]
-        vers = [r[:] for r in ver_refs]
-        vals = [r[:] for r in val_refs]
-        vavs = [r[:] for r in vav_refs]
-        live = ((tag >> 29) & 1) == 1
-        idx_u = tag & jnp.uint32((1 << 29) - 1)
-
-        # prev-element carries (u32 scalars) are READ before any store
-        old_key = [tails[t_key + s, LANES - 1] for s in range(nk)]
-        old_tag = tails[t_tag, LANES - 1]
-        old_ver = [tails[t_ver + s, LANES - 1] for s in range(nver)]
-        old_run = [tails[t_run + s, LANES - 1] for s in range(nR)]
-        # (1,128) running-carry rows (stored pre-broadcast across lanes:
-        # Mosaic supports (1,128)->(R,128) sublane-only broadcast, not
-        # (1,1)->(R,128) both-axes)
-        old_run_v = [tails[t_run + s:t_run + s + 1, :] for s in range(nR)]
-
-        def prev_of(x, fill_u32):
-            return flat_shift_down(x, 1, fill_u32, interpret)
-
-        neqb = jnp.zeros(tag.shape, bool)
-        for s in range(nk):
-            neqb = neqb | (keys[s] != prev_of(keys[s], old_key[s]))
-        f0 = neqb.astype(jnp.int32)
-
-        ptag = prev_of(tag, old_tag)
-        prev_live = ((ptag >> 29) & 1) == 1
-        if nver:
-            coll = jnp.zeros(tag.shape, bool)
-            for s in range(nver):
-                coll = coll | (vers[s] != prev_of(vers[s], old_ver[s]))
-            coll = coll & (~neqb) & live & prev_live
-            carr[1] = carr[1] + jnp.sum(coll.astype(jnp.int32))
-
-        # store prev-element carries for the NEXT block
-        for s in range(nk):
-            tails[t_key + s:t_key + s + 1, :] = keys[s][BR - 1:BR, :]
-        tails[t_tag:t_tag + 1, :] = tag[BR - 1:BR, :]
-        for s in range(nver):
-            tails[t_ver + s:t_ver + s + 1, :] = vers[s][BR - 1:BR, :]
-
-        # segmented running streams with cross-block carry absorption
-        runnings = []  # u32 blocks: rep, vcnts, aggs, live_cnt
-
-        def run_scan(v_arith, comb, ident, slot):
-            sc, f_acc = seg_scan(v_arith, f0, comb, ident, interpret)
-            carry = _bc(old_run_v[slot], v_arith.dtype)  # (1,128) row
-            sc = jnp.where((f_acc == 0) & (i > 0), comb(carry, sc), sc)
-            u = _bc(sc, jnp.uint32)
-            tails[t_run + slot:t_run + slot + 1, :] = jnp.broadcast_to(
-                u[BR - 1:BR, LANES - 1:LANES], (1, LANES))
-            runnings.append(u)
-            return sc
-
-        # rep scan runs in int32 (values < 2^29 are sign-safe): Mosaic
-        # has no unsigned vector min
-        rep_v = _bc(jnp.where(live, idx_u, jnp.uint32(0x1FFFFFFF)),
-                    jnp.int32)
-        run_scan(rep_v, jnp.minimum, jnp.int32(0x1FFFFFFF), 0)
-        # all-valid columns share ONE live-count scan (slot of the first
-        # such column); only nullable columns pay their own scan
-        shared_lc_slot = None
-        for ci in range(ncols):
-            if vvalid_idx[ci] >= 0:
-                ok = live & (vavs[vvalid_idx[ci]] != 0)
-                run_scan(ok.astype(jnp.int32), jax.lax.add, jnp.int32(0),
-                         1 + ci)
-            elif shared_lc_slot is None:
-                shared_lc_slot = 1 + ci
-                run_scan(live.astype(jnp.int32), jax.lax.add,
-                         jnp.int32(0), 1 + ci)
-            else:
-                tails[t_run + 1 + ci:t_run + 2 + ci, :] = \
-                    tails[t_run + shared_lc_slot:
-                          t_run + shared_lc_slot + 1, :]
-                runnings.append(runnings[shared_lc_slot])
-        for si, (vi, op, kind) in enumerate(specs):
-            conv, comb, ident, _back = _agg_arith(kind, op)
-            x = conv(vals[vi])
-            if vvalid_idx[vi] >= 0:
-                ok = live & (vavs[vvalid_idx[vi]] != 0)
-            else:
-                ok = live
-            x = jnp.where(ok, x, ident)
-            run_scan(x, comb, ident, 1 + ncols + si)
-        if shared_lc_slot is not None:
-            slot = 1 + ncols + nspec
-            tails[t_run + slot:t_run + slot + 1, :] = \
-                tails[t_run + shared_lc_slot:t_run + shared_lc_slot + 1, :]
-            runnings.append(runnings[shared_lc_slot])
-            lc = _bc(runnings[shared_lc_slot], jnp.int32)
-        else:
-            lc = run_scan(live.astype(jnp.int32), jax.lax.add,
-                          jnp.int32(0), 1 + ncols + nspec)
-
-        # emission: run starts emit the PREVIOUS element's running values
-        # (the finished previous run); the global last slot flushes the
-        # pending run (guaranteed-dead pad => no conflict)
-        prev_runnings = [prev_of(r, old_run[s])
-                         for s, r in enumerate(runnings)]
-        prev_lc = _bc(prev_runnings[1 + ncols + nspec], jnp.int32)
-        emit = neqb & (prev_lc > 0)
-        fi = flat_iota((BR, LANES))
-        is_last_slot = (fi == BR * LANES - 1) & (i == last_block)
-        flush = is_last_slot & (lc > 0)
-        emit = emit | flush
-        out_vals = [jnp.where(flush, r, pr)
-                    for r, pr in zip(runnings[:nO], prev_runnings[:nO])]
-
-        _compact_write(BR, emit.astype(jnp.int32), out_vals, list(outs),
-                       carr, 0, tails, 0, bufs, sems, 0, interpret)
-
-        @pl.when(i == last_block)
-        def _():
-            cnt_ref[0] = carr[0]
-            cnt_ref[1] = carr[1]
-
-    res = pl.pallas_call(
-        kernel,
-        out_shape=out_shapes,
-        grid=(blocks,),
-        in_specs=[pl.BlockSpec((BR, LANES), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM)]
-        * (nk + 1 + nver + ncols + len(valids_s)),
-        out_specs=([pl.BlockSpec(memory_space=pl.ANY)] * nO
-                   + [pl.BlockSpec(memory_space=pltpu.SMEM)]),
-        scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
-        interpret=interpret,
-    )
-    with _x32_trace():
-        res = res(*k2, t2, *ver2, *v2, *va2)
-    return res[nO], tuple(res[:nO])
+# A groupby_stream kernel (segmented-scan grouped aggregation) lived
+# here through rounds 2-3; it measured 10-11M rows/s vs the XLA segment
+# path's 13-19M on v5e and was removed per the round-3 review rather
+# than shipped as a slower parallel implementation (see git history).
+# ---------------------------------------------------------------------------

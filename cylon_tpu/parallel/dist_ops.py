@@ -50,7 +50,8 @@ from ..status import Code, CylonError
 from ..telemetry import phase as _phase
 from . import shard
 from ..util import capacity as _capacity
-from .shuffle import count_pair, exchange, replicated_gather
+from .shuffle import count_pair, exchange, exchange_pair, \
+    replicated_gather
 
 
 # ---------------------------------------------------------------------------
@@ -323,17 +324,13 @@ def _from_lanes_sharded(ctx: CylonContext, lanes, lengths):
                     shard_geom=(rows, rows * K), stride=K)
 
 
-def _exchange_table(t: Table, targets, emit, ctx: CylonContext,
-                    extra: Optional[dict] = None, counts=None):
-    """Shuffle a whole table's columns (fixed-width AND varbytes) plus
-    optional extra per-row arrays. Returns (columns, new_emit,
-    extra_out).
-
-    Short varbytes columns (≤ LANE_WORDS_MAX words) ride the ROW
-    exchange as fixed word lanes — no second word-level exchange, no
-    extra count sync, no starts reconcile (the lane payloads move like
-    any fixed-width column and reassemble as a strided layout). Long
-    varbytes keep the word-leg exchange."""
+def _build_exchange_payload(t: Table, ctx: CylonContext,
+                            extra: Optional[dict]):
+    """Payload leaves for a table shuffle. Short varbytes columns
+    (≤ LANE_WORDS_MAX words) ride the ROW exchange as fixed word lanes —
+    no second word-level exchange, no extra count sync, no starts
+    reconcile. All-valid columns skip the mask leaf entirely (validity
+    None round-trips as None — one less sort operand per column)."""
     from ..data.strings import LANE_WORDS_MAX
 
     payload = dict(extra or {})
@@ -341,8 +338,6 @@ def _exchange_table(t: Table, targets, emit, ctx: CylonContext,
     for i, c in enumerate(t._columns):
         payload[f"d{i}"] = c.data  # byte lengths for varbytes columns
         if c.validity is not None:
-            # all-valid columns skip the mask leaf entirely (validity
-            # None round-trips as None — one less sort operand per col)
             payload[f"v{i}"] = c.valid_mask()
         if c.is_varbytes and c.varbytes.max_words <= LANE_WORDS_MAX:
             vb = c.varbytes
@@ -353,11 +348,12 @@ def _exchange_table(t: Table, targets, emit, ctx: CylonContext,
             for k, l in enumerate(lanes):
                 payload[f"d{i}w{k}"] = l
     payload = {k: shard.pin(v, ctx) for k, v in payload.items()}
-    if counts is None:
-        out, new_emit, _cap, meta = exchange(payload, targets, emit, ctx)
-    else:
-        out, new_emit, _cap, meta = exchange(payload, targets, emit, ctx,
-                                             counts=counts)
+    return payload, lane_cols
+
+
+def _finish_exchange_table(t: Table, ctx: CylonContext, targets, emit,
+                           out, new_emit, meta, lane_cols,
+                           extra: Optional[dict]):
     cols = []
     for i, c in enumerate(t._columns):
         d, v = out[f"d{i}"], out.get(f"v{i}")
@@ -381,6 +377,36 @@ def _exchange_table(t: Table, targets, emit, ctx: CylonContext,
             cols.append(Column(d, c.dtype, v, c.dictionary, c.name))
     extra_out = {k: out[k] for k in (extra or {})}
     return cols, new_emit, extra_out
+
+
+def _exchange_table(t: Table, targets, emit, ctx: CylonContext,
+                    extra: Optional[dict] = None, counts=None):
+    """Shuffle a whole table's columns (fixed-width AND varbytes) plus
+    optional extra per-row arrays. Returns (columns, new_emit,
+    extra_out)."""
+    payload, lane_cols = _build_exchange_payload(t, ctx, extra)
+    if counts is None:
+        out, new_emit, _cap, meta = exchange(payload, targets, emit, ctx)
+    else:
+        out, new_emit, _cap, meta = exchange(payload, targets, emit, ctx,
+                                             counts=counts)
+    return _finish_exchange_table(t, ctx, targets, emit, out, new_emit,
+                                  meta, lane_cols, extra)
+
+
+def _exchange_table_pair(t1: Table, tg1, e1, c1, t2: Table, tg2, e2, c2,
+                         ctx: CylonContext):
+    """Two-table shuffle in ONE compiled program when both sides route
+    padded (exchange_pair) — the distributed join/set-op composition."""
+    p1, lc1 = _build_exchange_payload(t1, ctx, None)
+    p2, lc2 = _build_exchange_payload(t2, ctx, None)
+    r1, r2 = exchange_pair(p1, tg1, e1, c1, p2, tg2, e2, c2, ctx)
+    out1, ne1, _cap1, m1 = r1
+    out2, ne2, _cap2, m2 = r2
+    return (_finish_exchange_table(t1, ctx, tg1, e1, out1, ne1, m1, lc1,
+                                   None),
+            _finish_exchange_table(t2, ctx, tg2, e2, out2, ne2, m2, lc2,
+                                   None))
 
 
 # -- per-shard varlen gather (count → take at worst-shard capacity) --
@@ -901,20 +927,25 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
         # charges ~100 ms per round trip, so fusing halves the fixed
         # cost of the composition)
         ex = [p for p in plan if p[0] == "exchange"]
-        pair = {}
+        results = {}
         if len(ex) == 2:
             cl, cr = count_pair(ex[0][2], ex[0][3], ex[1][2], ex[1][3],
                                 ctx)
-            pair[id(ex[0])] = cl
-            pair[id(ex[1])] = cr
+            r1, r2 = _exchange_table_pair(
+                ex[0][1], ex[0][2], ex[0][3], cl,
+                ex[1][1], ex[1][2], ex[1][3], cr, ctx)
+            results[id(ex[0])] = r1
+            results[id(ex[1])] = r2
         for p in plan:
             kind, t, targets, emit = p
             if kind == "skip":
                 shuffled.append((t._columns, t.row_mask,
                                  shard.pin(t.emit_mask(), ctx)))
                 continue
-            cols, emit_s, _x = _exchange_table(
-                t, targets, emit, ctx, counts=pair.get(id(p)))
+            if id(p) in results:
+                cols, emit_s, _x = results[id(p)]
+            else:
+                cols, emit_s, _x = _exchange_table(t, targets, emit, ctx)
             shuffled.append((cols, emit_s, emit_s))
 
     # rebuild key bits from the SHUFFLED columns (word lanes reshape out
@@ -1694,16 +1725,23 @@ def distributed_sort(table: Table, order_by, ascending=True) -> Table:
         emit = shard.pin(t.emit_mask(), ctx)
         splitters = _range_splitters(ctx, lanes, emit)
         targets = _splitter_targets(lanes, splitters)
-        extra = {f"sb{i}": l for i, l in enumerate(lanes)}
-        cols_s, emit_s, xout = _exchange_table(
-            t, shard.pin(targets, ctx), emit, ctx, extra)
+        cols_s, emit_s, _x = _exchange_table(
+            t, shard.pin(targets, ctx), emit, ctx)
 
     with _phase("distributed_sort.local", seq):
+        # key lanes recompute per shard from the shuffled columns —
+        # recomputable lanes never cross the exchange (same pattern as
+        # the join/set-op/groupby shuffles)
+        t_s = Table(list(cols_s), ctx, emit_s)
+        order_cols_s = [t_s._columns[i] for i in idxs]
+        per_col_s = [_dist_order_lanes(ctx, c, a)
+                     for c, a in zip(order_cols_s, asc)]
+        sbits = tuple(shard.pin(l, ctx)
+                      for col_lanes in per_col_s for l in col_lanes)
         dat = tuple(shard.pin(c.data, ctx) for c in cols_s)
         val = tuple(shard.pin(c.valid_mask(), ctx) for c in cols_s)
-        sbits = tuple(xout[f"sb{i}"] for i in range(len(lanes)))
         sdat, sval, semit, perm = _shard_sort_fn(
-            ctx.mesh, len(dat), len(val), len(lanes))(
+            ctx.mesh, len(dat), len(val), len(sbits))(
             sbits, emit_s, dat, val)
     out_cols = []
     for d, v, c in zip(sdat, sval, cols_s):

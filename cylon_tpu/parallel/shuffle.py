@@ -171,6 +171,29 @@ def _send_block(xs, start, o, block, world):
     return jnp.stack(outs)
 
 
+def _padded_body(axis, world, block, payload, targets, emit):
+    """The padded-mode exchange as a pure function of per-shard values —
+    shared by the single and the PAIR program builders."""
+    cap_out = world * block
+    sorted_leaves, counts_out, start = _bucket_sort(
+        payload, targets, emit, world)
+    counts_in = jax.lax.all_to_all(counts_out, axis, split_axis=0,
+                                   concat_axis=0, tiled=True)
+
+    def one(xs):
+        pad = jnp.zeros((block,) + xs.shape[1:], xs.dtype)
+        xp = jnp.concatenate([xs, pad])
+        send = _send_block(xp, start, 0, block, world)
+        recv = jax.lax.all_to_all(send, axis, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        return recv.reshape((cap_out,) + xs.shape[1:])
+
+    outs = jax.tree.map(one, sorted_leaves)
+    pos = jnp.arange(cap_out, dtype=jnp.int32)
+    new_emit = (pos % block) < jnp.take(counts_in, pos // block)
+    return outs, new_emit, counts_in
+
+
 @lru_cache(maxsize=None)
 def _exchange_padded_fn(mesh, block: int):
     """Scatter-free single-shot exchange: every (src,dst) pair moves ONE
@@ -181,29 +204,79 @@ def _exchange_padded_fn(mesh, block: int):
     axis = mesh.axis_names[0]
     world = mesh.devices.size
     spec = P(axis)
-    cap_out = world * block
 
     def kernel(payload, targets, emit):
-        sorted_leaves, counts_out, start = _bucket_sort(
-            payload, targets, emit, world)
-        counts_in = jax.lax.all_to_all(counts_out, axis, split_axis=0,
-                                       concat_axis=0, tiled=True)
-
-        def one(xs):
-            pad = jnp.zeros((block,) + xs.shape[1:], xs.dtype)
-            xp = jnp.concatenate([xs, pad])
-            send = _send_block(xp, start, 0, block, world)
-            recv = jax.lax.all_to_all(send, axis, split_axis=0,
-                                      concat_axis=0, tiled=False)
-            return recv.reshape((cap_out,) + xs.shape[1:])
-
-        outs = jax.tree.map(one, sorted_leaves)
-        pos = jnp.arange(cap_out, dtype=jnp.int32)
-        new_emit = (pos % block) < jnp.take(counts_in, pos // block)
-        return outs, new_emit, counts_in
+        return _padded_body(axis, world, block, payload, targets, emit)
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec))
+
+
+@lru_cache(maxsize=None)
+def _exchange_padded_pair_fn(mesh, block1: int, block2: int):
+    """BOTH sides of a two-table shuffle in ONE compiled program — one
+    dispatch instead of two, and XLA schedules the two bucket sorts and
+    collective pairs together (the distributed join's composition cost
+    is dominated by fixed per-program cost through the axon tunnel)."""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+
+    def kernel(p1, t1, e1, p2, t2, e2):
+        o1 = _padded_body(axis, world, block1, p1, t1, e1)
+        o2 = _padded_body(axis, world, block2, p2, t2, e2)
+        return o1 + o2
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
+                             out_specs=spec))
+
+
+def exchange_pair(payload1, targets1, emit1, counts1,
+                  payload2, targets2, emit2, counts2, ctx: CylonContext):
+    """Two shuffles in one program when both route to padded mode
+    (the uniform-hash common case); otherwise two sequential
+    exchanges. Returns (result1, result2) where each result is the
+    exchange() 4-tuple."""
+    world = ctx.get_world_size()
+    budget = ctx.memory_pool.comm_budget_bytes()
+
+    def route(counts, payload):
+        """Same padded-mode routing exchange() applies, INCLUDING the
+        HBM comm-budget block shrink — a pair program allocates both
+        tables' padded buffers at once, so skipping the budget guard
+        here would OOM exactly the wide-payload cases the budget
+        exists for."""
+        max_pair = int(counts.max()) if counts.size else 0
+        recv_max = int(counts.sum(axis=0).max()) if counts.size else 0
+        block_p = _pow2(max_pair)
+        mb = MAX_BLOCK
+        bytes_per_row = sum(
+            int(np.dtype(x.dtype).itemsize) * int(np.prod(x.shape[1:]))
+            for x in jax.tree.leaves(payload)) or 4
+        if budget:
+            # halve the per-table budget: the pair program holds both
+            while mb > 1024 and 8 * world * mb * bytes_per_row > budget:
+                mb //= 2
+        mb = 1 << (max(int(mb), 1).bit_length() - 1)
+        ok = (world * block_p
+              <= PADDED_WASTE_FACTOR * max(_pow2(recv_max), 1)
+              and block_p <= mb)
+        return ok, block_p
+
+    ok1, b1 = route(counts1, payload1)
+    ok2, b2 = route(counts2, payload2)
+    if ok1 and ok2:
+        seq = ctx.get_next_sequence()
+        with _phase("shuffle.exchange_pair", seq):
+            res = _exchange_padded_pair_fn(ctx.mesh, b1, b2)(
+                payload1, targets1, emit1, payload2, targets2, emit2)
+        out1, emit1_o, ci1, out2, emit2_o, ci2 = res
+        return ((out1, emit1_o, world * b1,
+                 {"mode": "padded", "block": b1, "counts_in": ci1}),
+                (out2, emit2_o, world * b2,
+                 {"mode": "padded", "block": b2, "counts_in": ci2}))
+    return (exchange(payload1, targets1, emit1, ctx, counts=counts1),
+            exchange(payload2, targets2, emit2, ctx, counts=counts2))
 
 
 @lru_cache(maxsize=None)

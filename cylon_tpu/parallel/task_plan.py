@@ -63,7 +63,13 @@ def task_exchange(table: Table, task_ids, plan: LogicalTaskPlan,
     ``__task__`` (receivers filter their own tasks locally)."""
     ctx = ctx or table._ctx
     t = shard.distribute(table, ctx)
-    ids = jnp.asarray(np.asarray(task_ids).astype(np.int32))
+    host_ids = np.asarray(task_ids).astype(np.int32)
+    unknown = set(np.unique(host_ids).tolist()) - set(
+        plan.task_to_worker)
+    if unknown:
+        raise CylonError(Code.KeyError,
+                         f"task ids not in plan: {sorted(unknown)[:8]}")
+    ids = jnp.asarray(host_ids)
     if ids.shape[0] != t.capacity:
         # pad to the distributed capacity (dead rows never route)
         pad = t.capacity - ids.shape[0]

@@ -42,6 +42,15 @@ def remove_table(table_id: str) -> None:
         _tables.pop(table_id, None)
 
 
+def new_table_id(prefix: str = "t") -> str:
+    """Fresh unique registry id (reference: util/uuid.hpp generate_uuid —
+    the reference mints ids for intermediate JNI tables; callers here may
+    also pass their own)."""
+    import uuid
+
+    return f"{prefix}-{uuid.uuid4().hex[:12]}"
+
+
 def registered_ids() -> List[str]:
     with _lock:
         return sorted(_tables)

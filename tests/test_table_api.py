@@ -77,3 +77,11 @@ def test_retain_memory_frees_inputs():
     # the non-retained input was cleared after use, the retained one kept
     assert left.column_count == 0
     assert right.column_count == 2
+
+
+def test_new_table_id_unique():
+    from cylon_tpu import table_api
+
+    ids = {table_api.new_table_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all(i.startswith("t-") for i in ids)

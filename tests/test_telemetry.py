@@ -29,10 +29,14 @@ def test_dist_phase_logs(dist_ctx, caplog):
     with caplog.at_level(logging.INFO, logger="cylon_tpu"):
         t1.distributed_join(t2, "inner", on="k")
     msgs = [r.message for r in caplog.records]
-    for prefix in ("distributed_join.shuffle#", "distributed_join.plan#",
-                   "distributed_join.materialize#", "shuffle.count#",
-                   "shuffle.exchange#"):
-        assert any(m.startswith(prefix) for m in msgs), (prefix, msgs)
+    for prefixes in (("distributed_join.shuffle#",),
+                     ("distributed_join.plan#",),
+                     ("distributed_join.materialize#",),
+                     ("shuffle.count#",),
+                     # both sides' exchanges fuse into one program when
+                     # uniform (exchange_pair); skew falls back per side
+                     ("shuffle.exchange#", "shuffle.exchange_pair#")):
+        assert any(m.startswith(p) for p in prefixes for m in msgs),             (prefixes, msgs)
 
 
 def test_row_count_cached(local_ctx):
