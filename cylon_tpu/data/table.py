@@ -1022,7 +1022,7 @@ def join_blocked(left: Table, right: Table, config: _join.JoinConfig,
         else:
             cfg = _join.JoinConfig(sub_type, config.left_column_idx,
                                    config.right_column_idx,
-                                   config.algorithm)
+                                   config.algorithm, exact=config.exact)
             blocks.append(_join_once(blk, other, cfg))
     out = concat_tables(blocks, left._ctx) if len(blocks) > 1 \
         else blocks[0]

@@ -239,32 +239,11 @@ def exchange_pair(payload1, targets1, emit1, counts1,
     exchange() 4-tuple."""
     world = ctx.get_world_size()
     budget = ctx.memory_pool.comm_budget_bytes()
-
-    def route(counts, payload):
-        """Same padded-mode routing exchange() applies, INCLUDING the
-        HBM comm-budget block shrink — a pair program allocates both
-        tables' padded buffers at once, so skipping the budget guard
-        here would OOM exactly the wide-payload cases the budget
-        exists for."""
-        max_pair = int(counts.max()) if counts.size else 0
-        recv_max = int(counts.sum(axis=0).max()) if counts.size else 0
-        block_p = _pow2(max_pair)
-        mb = MAX_BLOCK
-        bytes_per_row = sum(
-            int(np.dtype(x.dtype).itemsize) * int(np.prod(x.shape[1:]))
-            for x in jax.tree.leaves(payload)) or 4
-        if budget:
-            # halve the per-table budget: the pair program holds both
-            while mb > 1024 and 8 * world * mb * bytes_per_row > budget:
-                mb //= 2
-        mb = 1 << (max(int(mb), 1).bit_length() - 1)
-        ok = (world * block_p
-              <= PADDED_WASTE_FACTOR * max(_pow2(recv_max), 1)
-              and block_p <= mb)
-        return ok, block_p
-
-    ok1, b1 = route(counts1, payload1)
-    ok2, b2 = route(counts2, payload2)
+    # buffer_factor=8: the pair program holds BOTH tables' comm buffers
+    ok1, b1, _mb1 = _padded_route(counts1, payload1, world, budget,
+                                  buffer_factor=8)
+    ok2, b2, _mb2 = _padded_route(counts2, payload2, world, budget,
+                                  buffer_factor=8)
     if ok1 and ok2:
         seq = ctx.get_next_sequence()
         with _phase("shuffle.exchange_pair", seq):
@@ -372,6 +351,37 @@ def count_pair(targets1, emit1, targets2, emit2, ctx: CylonContext):
     return both[:, 0, :], both[:, 1, :]
 
 
+def _budget_block_cap(payload, world: int, budget, mb: int,
+                      buffer_factor: int) -> int:
+    """Shrink the per-round block cap so buffer_factor * world * block *
+    row_bytes fits the comm budget (pow2-floored) — the Allocator analog
+    feeding receive buffers from the pool
+    (arrow_all_to_all.cpp:234-247)."""
+    bytes_per_row = sum(
+        int(np.dtype(x.dtype).itemsize) * int(np.prod(x.shape[1:]))
+        for x in jax.tree.leaves(payload)) or 4
+    if budget:
+        while mb > 1024 and buffer_factor * world * mb * bytes_per_row                 > budget:
+            mb //= 2
+    return 1 << (max(int(mb), 1).bit_length() - 1)
+
+
+def _padded_route(counts, payload, world: int, budget,
+                  buffer_factor: int = 4, max_block: int = None):
+    """(padded_ok, block) — ONE routing rule shared by exchange() and
+    exchange_pair() so the two paths can never silently diverge."""
+    max_pair = int(counts.max()) if counts.size else 0
+    recv_max = int(counts.sum(axis=0).max()) if counts.size else 0
+    block_p = _pow2(max_pair)
+    mb = _budget_block_cap(payload, world, budget,
+                           MAX_BLOCK if max_block is None else max_block,
+                           buffer_factor)
+    ok = (world * block_p
+          <= PADDED_WASTE_FACTOR * max(_pow2(recv_max), 1)
+          and block_p <= mb)
+    return ok, block_p, mb
+
+
 def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
              emit: jnp.ndarray, ctx: CylonContext,
              max_block: Optional[int] = None,
@@ -406,25 +416,12 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
                 _count_fn(ctx.mesh)(targets, emit)))
     max_pair = int(counts.max()) if counts.size else 0
     recv_max = int(counts.sum(axis=0).max()) if counts.size else 0
-    mb = max_block if max_block is not None else MAX_BLOCK
-    # the memory pool bounds in-flight comm buffers; shrink the block cap
-    # to fit the HBM budget — the reference's analog is the Allocator
-    # feeding receive buffers from the pool (arrow_all_to_all.cpp:234-247)
     budget = ctx.memory_pool.comm_budget_bytes()
-    bytes_per_row = sum(
-        int(np.dtype(x.dtype).itemsize) * int(np.prod(x.shape[1:]))
-        for x in jax.tree.leaves(payload)) or 4
-    if budget:
-        while mb > 1024 and 4 * world * mb * bytes_per_row > budget:
-            mb //= 2
-    # floor-pow2 the cap so the documented memory bound is never exceeded
-    mb = 1 << (max(int(mb), 1).bit_length() - 1)
-
-    block_p = _pow2(max_pair)
+    padded_ok, block_p, mb = _padded_route(counts, payload, world, budget,
+                                           buffer_factor=4,
+                                           max_block=max_block)
     cap_padded = world * block_p
     cap_compact = _pow2(recv_max)
-    padded_ok = (cap_padded <= PADDED_WASTE_FACTOR * max(cap_compact, 1)
-                 and block_p <= mb)
     with _phase("shuffle.exchange", seq):
         if padded_ok:
             out, new_emit, counts_in = _exchange_padded_fn(

@@ -61,11 +61,18 @@ def task_exchange(table: Table, task_ids, plan: LogicalTaskPlan,
     into one routed exchange. ``task_ids``: per-row int array. Returns
     the routed table with the task-id column appended as
     ``__task__`` (receivers filter their own tasks locally)."""
+    import jax
+
     ctx = ctx or table._ctx
     t = shard.distribute(table, ctx)
     host_ids = np.asarray(task_ids).astype(np.int32)
-    unknown = set(np.unique(host_ids).tolist()) - set(
-        plan.task_to_worker)
+    # validate LIVE rows only — dead (masked) slots may carry filler
+    # ids and never route
+    live = host_ids
+    if t.row_mask is not None and host_ids.shape[0] == t.capacity:
+        mask = np.asarray(jax.device_get(t.row_mask))
+        live = host_ids[mask[: host_ids.shape[0]]]
+    unknown = set(np.unique(live).tolist()) - set(plan.task_to_worker)
     if unknown:
         raise CylonError(Code.KeyError,
                          f"task ids not in plan: {sorted(unknown)[:8]}")

@@ -1,0 +1,107 @@
+/* Second-language binding demo: a C program drives the engine through
+ * the table_api string-id registry, the way the reference's Java/JNI
+ * layer consumes its C++ registry (reference:
+ * java/src/main/native/src/Table.cpp:26-67 — JNI functions resolve
+ * string table ids against table_api.hpp and invoke the operators).
+ *
+ * The engine here is Python-resident (JAX/XLA is the compute runtime),
+ * so the C side embeds the interpreter and talks ONLY in C types +
+ * string ids: no Python objects cross the call sites below, which is
+ * exactly the contract a JNI/FFI layer needs. Build + run:
+ *   sh scripts/build_cbind.sh
+ */
+#include <Python.h>
+#include <stdio.h>
+#include <string.h>
+
+static int check(PyObject *o, const char *what) {
+    if (o != NULL) { Py_DECREF(o); return 0; }
+    fprintf(stderr, "FAILED: %s\n", what);
+    PyErr_Print();
+    return 1;
+}
+
+/* C-ABI style wrappers over the registry (the JNI-analog surface) */
+static PyObject *g_api = NULL;
+static PyObject *g_ctx = NULL;
+
+static int ct_read_csv(const char *path, const char *table_id) {
+    PyObject *r = PyObject_CallMethod(g_api, "read_csv", "Oss",
+                                      g_ctx, path, table_id);
+    return check(r, "read_csv");
+}
+
+static int ct_join(const char *left_id, const char *right_id,
+                   int left_col, int right_col, const char *out_id) {
+    PyObject *join_mod = PyImport_ImportModule("cylon_tpu.ops.join");
+    if (!join_mod) { PyErr_Print(); return 1; }
+    PyObject *cfg_cls = PyObject_GetAttrString(join_mod, "JoinConfig");
+    PyObject *cfg = cfg_cls
+        ? PyObject_CallMethod(cfg_cls, "InnerJoin", "ii",
+                              left_col, right_col)
+        : NULL;
+    int rc = 1;
+    if (cfg) {
+        PyObject *r = PyObject_CallMethod(g_api, "join_tables", "ssOs",
+                                          left_id, right_id, cfg, out_id);
+        rc = check(r, "join_tables");
+    } else {
+        PyErr_Print();
+    }
+    Py_XDECREF(cfg);
+    Py_XDECREF(cfg_cls);
+    Py_DECREF(join_mod);
+    return rc;
+}
+
+static long ct_row_count(const char *table_id) {
+    PyObject *r = PyObject_CallMethod(g_api, "row_count", "s", table_id);
+    if (!r) { PyErr_Print(); return -1; }
+    long n = PyLong_AsLong(r);
+    Py_DECREF(r);
+    return n;
+}
+
+static int ct_write_csv(const char *table_id, const char *path) {
+    PyObject *r = PyObject_CallMethod(g_api, "write_csv", "ss",
+                                      table_id, path);
+    return check(r, "write_csv");
+}
+
+int main(int argc, char **argv) {
+    const char *csv1 = argc > 1 ? argv[1]
+        : "/root/reference/data/input/csv1_0.csv";
+    const char *csv2 = argc > 2 ? argv[2]
+        : "/root/reference/data/input/csv2_0.csv";
+    const char *out = argc > 3 ? argv[3] : "/tmp/cbind_join.csv";
+
+    Py_Initialize();
+    /* force the CPU backend: the binding demo must not depend on an
+     * attached accelerator */
+    PyRun_SimpleString(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n");
+
+    g_api = PyImport_ImportModule("cylon_tpu.table_api");
+    if (!g_api) { PyErr_Print(); return 2; }
+    PyObject *ct = PyImport_ImportModule("cylon_tpu");
+    if (!ct) { PyErr_Print(); return 2; }
+    PyObject *ctx_cls = PyObject_GetAttrString(ct, "CylonContext");
+    g_ctx = ctx_cls ? PyObject_CallMethod(ctx_cls, "Init", NULL) : NULL;
+    if (!g_ctx) { PyErr_Print(); return 2; }
+
+    if (ct_read_csv(csv1, "c-left")) return 3;
+    if (ct_read_csv(csv2, "c-right")) return 3;
+    if (ct_join("c-left", "c-right", 0, 0, "c-out")) return 3;
+    long rows = ct_row_count("c-out");
+    if (rows < 0) return 3;
+    if (ct_write_csv("c-out", out)) return 3;
+    printf("CBIND OK rows=%ld out=%s\n", rows, out);
+
+    Py_XDECREF(ctx_cls);
+    Py_DECREF(ct);
+    Py_DECREF(g_ctx);
+    Py_DECREF(g_api);
+    Py_Finalize();
+    return 0;
+}

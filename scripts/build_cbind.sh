@@ -1,0 +1,11 @@
+#!/bin/sh
+# Build + run the C binding demo (native/cylon_cbind.c): a C program
+# consuming the table_api string-id registry — the JNI-analog proof
+# that the registry layer is language-neutral.
+set -e
+cd "$(dirname "$0")/.."
+mkdir -p cylon_tpu/_native
+gcc -O2 native/cylon_cbind.c -o cylon_tpu/_native/cylon_cbind \
+    $(python3-config --includes) $(python3-config --embed --ldflags)
+PYTHONPATH="$(pwd)${PYTHONPATH:+:$PYTHONPATH}" \
+    ./cylon_tpu/_native/cylon_cbind "$@"

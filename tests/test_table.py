@@ -160,3 +160,20 @@ def test_global_sort_fallback_varbytes_payload(local_ctx):
     finally:
         _strings.DICT_MAX_VOCAB = old
     assert list(s.to_pydict()["s"]) == ["a", "bb", "ccc"]
+
+
+
+def test_unique_names_no_silent_drop(local_ctx):
+    """Duplicate column names suffix (_2, _3) so dict exports keep every
+    column (restored: this guard was accidentally deleted with the
+    stream-groupby test module in round 4)."""
+    from cylon_tpu.data.column import Column
+    from cylon_tpu.data.table import Table
+
+    cols = [Column.from_numpy(np.arange(3), "a"),
+            Column.from_numpy(np.arange(3, 6), "a_2"),
+            Column.from_numpy(np.arange(6, 9), "a")]
+    t = Table(cols, local_ctx)
+    d = t.to_pydict()
+    assert len(d) == 3
+    assert list(d.keys()) == ["a", "a_2", "a_3"]
