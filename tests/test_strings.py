@@ -581,3 +581,45 @@ def test_exact_join_rejects_forced_hash_collision(ctx, monkeypatch):
     # outer joins raise instead of silently reclassifying
     with pytest.raises(Exception):
         lt.join(rt, "left", on="k", exact=True)
+
+
+def test_lane_paths_edge_shapes(ctx, monkeypatch):
+    """Empty/one-row/all-empty-string tables through the word-lane
+    machinery (join outputs, takes, round trips)."""
+    _force_varbytes(monkeypatch)
+    # one row
+    t1 = ct.Table.from_pydict(ctx, {"k": np.array(["solo"], object),
+                                    "v": np.array([1])})
+    j1 = t1.join(t1, "inner", on="k")
+    assert j1.row_count == 1
+    assert j1.to_pandas().iloc[0, 0] == "solo"
+    # empty join result
+    t2 = ct.Table.from_pydict(ctx, {"k": np.array(["other"], object),
+                                    "w": np.array([2])})
+    j0 = t1.join(t2, "inner", on="k")
+    assert j0.row_count == 0
+    assert list(j0.to_pandas().columns) == ["lt-0", "lt-1", "rt-2", "rt-3"]
+    # all-empty-string keys (zero-word rows)
+    ke = np.array(["", "", "x"], object)
+    t3 = ct.Table.from_pydict(ctx, {"k": ke, "v": np.arange(3)})
+    j3 = t3.join(t3, "inner", on="k")
+    assert j3.row_count == 2 * 2 + 1
+    # strided output round-trips through arrow + csv
+    out = j1.to_arrow()
+    assert out.column("lt-0").to_pylist() == ["solo"]
+
+
+def test_strided_varbytes_filter_and_concat_chain(ctx, monkeypatch):
+    """Strided outputs flow through filter -> concat -> groupby (the
+    post-join pipeline shape)."""
+    _force_varbytes(monkeypatch)
+    n = 300
+    k = np.array([f"g{i % 7}" for i in range(n)], object)
+    t = ct.Table.from_pydict(ctx, {"k": k, "v": np.arange(n)})
+    j = t.join(t, "inner", on="k")          # strided varbytes output
+    f = j[j["lt-1"] > 100]                   # mask view
+    m = f.merge(f)                           # concat path
+    g = m.groupby(0, [1], [ct.AggregationOp.COUNT])
+    assert g.row_count <= 7
+    df = m.to_pandas()
+    assert (df.iloc[:, 0] == df.iloc[:, 2]).all()
