@@ -1,0 +1,54 @@
+"""The three string-column regimes and when each engages.
+
+1. DICTIONARY (low cardinality): sorted host vocab + int32 codes —
+   exact ordering, cheapest sorts (reference: pycylon relies on Arrow
+   dictionary arrays the same way).
+2. WORD LANES (high cardinality, rows <= 20 bytes): raw prefix words +
+   length are the join/group identity — byte-EXACT with zero hashing;
+   rows <= 32 bytes ride joins/shuffles as fixed u32 lanes.
+3. CONTENT HASH (longer rows): 96-bit polynomial triple + length
+   (< 2^-70 false-equal odds at 1B distinct keys); pass
+   ``join(..., exact=True)`` for a byte-verification pass over matched
+   pairs, or dictionary-encode for exact outer joins.
+
+Run: python examples/string_regimes_demo.py
+"""
+import numpy as np
+
+import cylon_tpu as ct
+from cylon_tpu.data import strings as _strings
+
+
+def main():
+    ctx = ct.CylonContext.Init()
+    rng = np.random.default_rng(0)
+    n = 5000
+
+    # 1. dictionary: few distinct values
+    cities = np.array(["paris", "tokyo", "lima", "oslo"], object)
+    t1 = ct.Table.from_pydict(ctx, {"city": cities[rng.integers(0, 4, n)],
+                                    "v": rng.normal(size=n)})
+    print("dictionary regime:", t1.get_column(0).dictionary is not None)
+
+    # 2. word lanes: high-cardinality short ids (byte-exact keys)
+    ids = np.array([f"acct-{i:08d}" for i in range(n)], object)
+    t2 = ct.Table.from_pydict(ctx, {"id": ids, "v": np.arange(n)})
+    c = t2.get_column(0)
+    print("varbytes:", c.is_varbytes,
+          "| exact lanes:",
+          c.varbytes.max_words <= _strings.EXACT_KEY_WORDS)
+    j = t2.join(t2, "inner", on="id")
+    print("self-join rows:", j.row_count, "(byte-exact, no hashing)")
+
+    # 3. content hash + exact=True for long keys
+    urls = np.array([f"https://example.com/item/{i:012d}/view"
+                     for i in range(n)], object)
+    t3 = ct.Table.from_pydict(ctx, {"url": urls, "v": np.arange(n)})
+    print("long keys words:", t3.get_column(0).varbytes.max_words,
+          "(> EXACT_KEY_WORDS -> 96-bit hash identity)")
+    jv = t3.join(t3, "inner", on="url", exact=True)
+    print("exact-verified join rows:", jv.row_count)
+
+
+if __name__ == "__main__":
+    main()
