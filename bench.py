@@ -65,12 +65,22 @@ def _time(fn, iters):
     return min(times)
 
 
-def _mk_ctx():
+def _mk_ctx(attempts: int = 3):
     import cylon_tpu as ct
 
     # a distributed context even at world 1: the bench times the real
-    # exchange path on whatever mesh is attached
-    return ct.CylonContext.InitDistributed(ct.TPUConfig())
+    # exchange path on whatever mesh is attached. Backend init is
+    # retried with backoff — a transient tunnel failure must not void
+    # the whole artifact (round-4 postmortem: BENCH_r04 rc=1).
+    delay = 5.0
+    for i in range(attempts):
+        try:
+            return ct.CylonContext.InitDistributed(ct.TPUConfig())
+        except Exception:
+            if i == attempts - 1:
+                raise
+            time.sleep(delay)
+            delay *= 3
 
 
 def _join_tables(ctx, n_rows):
@@ -159,7 +169,8 @@ def bench_shuffle(ctx, n_rows: int, iters: int) -> dict:
     bytes_per_row = 4 + 4 + 8
 
     def one():
-        out, new_emit, _cap, _meta = exchange(payload, targets, emit, ctx)
+        out, new_emit, _cap, _meta = exchange(payload, targets, emit, ctx,
+                                              dense=True)
         jax.device_get(out["a"][:1])
 
     best = _time(one, iters)
@@ -198,7 +209,8 @@ def bench_shuffle_wide(ctx, n_rows: int, iters: int) -> dict:
     emit = _shard.pin(jnp.ones(n_rows, dtype=bool), ctx)
 
     def one():
-        out, new_emit, _cap, _meta = exchange(payload, targets, emit, ctx)
+        out, new_emit, _cap, _meta = exchange(payload, targets, emit, ctx,
+                                              dense=True)
         jax.device_get(out["f0"][:1])
 
     best = _time(one, iters)
@@ -312,6 +324,101 @@ def bench_string_join(ctx, n_rows: int, iters: int) -> dict:
             "wall_s_best": round(best, 4)}
 
 
+def bench_dist_sort(ctx, n_rows: int, iters: int) -> dict:
+    """The honest DISTRIBUTED sort composition, forced even on a 1-wide
+    mesh: splitter sampling (one batched device_get), range partition
+    through the exchange, per-shard fused sort — the same machinery a
+    multi-chip global sort runs (round-4 gap: sort only ever timed the
+    local kernel on the 1-chip bench)."""
+    import cylon_tpu as ct
+    from cylon_tpu.parallel import dist_ops
+
+    rng = np.random.default_rng(2)
+    t = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, 1 << 31, n_rows).astype(np.int32),
+        "v": rng.normal(size=n_rows).astype(np.float32),
+    })
+
+    def one():
+        s = dist_ops.distributed_sort(t, "k", force_exchange=True)
+        _sync(s)
+
+    best = _time(one, iters)
+    world = max(ctx.get_world_size(), 1)
+    return {"rows_per_s_per_chip": n_rows / best / world,
+            "wall_s_best": round(best, 4)}
+
+
+def bench_dist_string_join(ctx, n_rows: int, iters: int) -> dict:
+    """DISTRIBUTED varbytes string-key join, forced exchange: the
+    round-4 word-lane machinery (string words riding the row exchange as
+    payload lanes) on the clock — bench_string_join times only the local
+    kernel."""
+    from cylon_tpu.ops.join import JoinConfig
+    from cylon_tpu.parallel import dist_ops
+    from cylon_tpu.data.strings import VarBytes
+    from cylon_tpu.data.column import Column
+    from cylon_tpu.data.table import Table
+
+    n_keys = max(n_rows // 4, 1)
+
+    def make(n, seed):
+        r = np.random.default_rng(seed)
+        ks = r.integers(0, n_keys, n)
+        hexd = np.frombuffer(b"0123456789abcdef", np.uint8)
+        b = np.empty((n, 12), np.uint8)
+        b[:, 0] = ord("u")
+        for j in range(8):
+            b[:, 1 + j] = hexd[(ks >> (28 - 4 * j)) & 0xF]
+        b[:, 9:] = ord("x")
+        lengths = np.full(n, 12, np.int32)
+        vb = VarBytes._from_packed(b.tobytes(), lengths)
+        cols = [Column.from_varbytes(vb, None, "k"),
+                Column.from_numpy(r.normal(size=n).astype(np.float32), "v")]
+        return Table(cols, ctx)
+
+    left = make(n_rows, 20)
+    right = make(n_rows, 21)
+    cfg = JoinConfig.InnerJoin([0], [0])
+    out = {}
+
+    def one():
+        t = dist_ops.distributed_join(left, right, cfg,
+                                      force_exchange=True)
+        _sync(t)
+        out["t"] = t
+
+    best = _time(one, iters)
+    world = max(ctx.get_world_size(), 1)
+    return {"rows_per_s_per_chip": 2 * n_rows / best / world,
+            "wall_s_best": round(best, 4),
+            "out_rows": out["t"].row_count}
+
+
+def bench_pandas_reference(n_rows: int, iters: int = 1) -> dict:
+    """Same workload, same host, pandas (the reference's Dask-comparison
+    discipline, cpp/src/experiments/dask_run.py — a competitor number
+    measured beside ours, not quoted from a paper). The full
+    engine-matrix harness is scripts/compare_competitors.py; this folds
+    the pandas join/groupby rows into the driver-verified artifact."""
+    import pandas as pd
+
+    rng = np.random.default_rng(0)
+    ldf = pd.DataFrame({"k": rng.integers(0, n_rows, n_rows).astype(np.int32),
+                        "v": rng.normal(size=n_rows).astype(np.float32)})
+    rdf = pd.DataFrame({"k": rng.integers(0, n_rows, n_rows).astype(np.int32),
+                        "w": rng.normal(size=n_rows).astype(np.float32)})
+    gdf = pd.DataFrame({"g": rng.integers(0, 1 << 20, n_rows).astype(np.int32),
+                        "x": rng.normal(size=n_rows).astype(np.float32)})
+    join_s = _time(lambda: ldf.merge(rdf, on="k"), iters)
+    group_s = _time(lambda: gdf.groupby("g").agg(
+        s=("x", "sum"), c=("x", "count"), m=("x", "mean")), iters)
+    return {"join_rows_per_s": 2 * n_rows / join_s,
+            "join_s": round(join_s, 4),
+            "groupby_rows_per_s": n_rows / group_s,
+            "groupby_s": round(group_s, 4)}
+
+
 def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
     import jax
 
@@ -331,11 +438,17 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
              lambda: bench_q5_pipeline(ctx, n_rows // 2, iters)),
             ("string_join",
              lambda: bench_string_join(ctx, n_rows // 4, iters)),
+            ("dist_string_join",
+             lambda: bench_dist_string_join(ctx, n_rows // 4, iters)),
+            ("dist_sort",
+             lambda: bench_dist_sort(ctx, n_rows, iters)),
             ("shuffle_wide",
              lambda: bench_shuffle_wide(ctx, n_rows, iters)),
             ("hbm_blocked_join",
              lambda: bench_hbm_blocked_join(ctx, n_rows * 12,
                                             n_rows * 3)),
+            ("pandas_reference",
+             lambda: bench_pandas_reference(n_rows // 4, iters)),
         ]
         for name, fn in configs:
             try:
@@ -464,6 +577,137 @@ def bench_q5_pipeline(ctx, n_rows: int, iters: int) -> dict:
             "wall_s_best": round(best, 4)}
 
 
+def cpu_fallback(n_rows: int = 1 << 16, iters: int = 1) -> dict:
+    """Small-scale artifact for when the TPU backend is out (round-4
+    postmortem): the full suite at correctness scale on the virtual CPU
+    mesh, PLUS an explicit distributed-vs-local content check so the
+    artifact still carries evidence the machinery is right even when the
+    chip can't carry evidence it is fast. Caller must have configured
+    jax for cpu BEFORE any backend touch."""
+    import cylon_tpu as ct
+
+    res = run(n_rows, iters, full=True)
+    ctx = _mk_ctx()
+    rng = np.random.default_rng(0)
+    n = 4096
+    left = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n // 4, n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32)})
+    right = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n // 4, n).astype(np.int32),
+        "w": rng.integers(0, 100, n).astype(np.int32)})
+    dj = left.distributed_join(right, "inner", on="k")
+    local = ct.CylonContext.Init()
+    lj = ct.Table.from_pydict(local, left.to_pydict()).join(
+        ct.Table.from_pydict(local, right.to_pydict()), "inner", on="k")
+
+    def canon(t):
+        cols = [np.asarray(v) for v in t.to_pydict().values()]
+        o = np.lexsort(tuple(reversed(cols)))
+        return [c[o] for c in cols]
+
+    match = all(np.array_equal(a, b)
+                for a, b in zip(canon(dj), canon(lj)))
+    res["detail"]["cpu_correctness"] = {
+        "dist_join_matches_local": bool(match),
+        "world": ctx.get_world_size(), "rows": n}
+    return res
+
+
+def _last_json_line(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _armored_main(a) -> dict:
+    """Outage-proof driver path (round-5, VERDICT item 1b): the parent
+    never imports jax — each attempt runs in a child interpreter with a
+    timeout, init failures retry with backoff, and a persistently dead
+    backend degrades to a CPU-mesh fallback artifact instead of
+    `parsed: null`. Reference bar: the benchmark harness always produces
+    its table (table_join_dist_test.cpp:28-63)."""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    full = not a.join_only
+
+    def attempt(boot: str, timeout: float):
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", boot], cwd=here,
+                capture_output=True, text=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return None, f"timeout after {timeout:.0f}s", time.monotonic() - t0
+        err = None
+        parsed = _last_json_line(proc.stdout)
+        if parsed is None:
+            tail = (proc.stderr or proc.stdout or "")[-1500:]
+            err = f"rc={proc.returncode}: {tail}"
+        if proc.stderr:
+            sys.stderr.write(proc.stderr[-2000:] + "\n")
+        return parsed, err, time.monotonic() - t0
+
+    real_boot = (
+        "import sys; sys.path.insert(0, {here!r})\n"
+        "import json, bench\n"
+        "print(json.dumps(bench.run({rows}, {iters}, full={full})))\n"
+    ).format(here=here, rows=a.rows, iters=a.iters, full=full)
+    probe_boot = "import jax; print(len(jax.devices()))"
+
+    errors = []
+    delay = 15.0
+    for i in range(3):
+        # cheap probe first: a HANG-mode outage (observed live in round
+        # 5 — jax.devices() never returns) must cost 60 s per attempt,
+        # not the full bench timeout
+        _probe, perr, ptook = attempt(probe_boot, timeout=60.0)
+        if perr is not None and _probe is None and "timeout" in perr:
+            errors.append(f"probe {i + 1}: {perr}")
+            sys.stderr.write(errors[-1] + "\n")
+        else:
+            parsed, err, took = attempt(real_boot, timeout=2700.0)
+            if parsed is not None:
+                return parsed
+            errors.append(f"attempt {i + 1} ({took:.0f}s): {err}")
+            sys.stderr.write(errors[-1] + "\n")
+            if took > 600:
+                # the child ran long before dying — a retry won't fit
+                # the budget and the failure is likely not
+                # init-transient
+                break
+        if i < 2:
+            time.sleep(delay)
+            delay *= 3
+
+    # persistent backend failure: CPU-mesh fallback artifact
+    cpu_boot = (
+        "import sys; sys.path.insert(0, {here!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "jax.config.update('jax_num_cpu_devices', 8)\n"
+        "import json, bench\n"
+        "print(json.dumps(bench.cpu_fallback()))\n"
+    ).format(here=here)
+    parsed, err, _took = attempt(cpu_boot, timeout=1800.0)
+    if parsed is not None:
+        parsed["detail"]["backend"] = "cpu-fallback"
+        parsed["detail"]["backend_error"] = errors
+        return parsed
+    errors.append(f"cpu fallback: {err}")
+    return {"metric": "dist_inner_join_rows_per_sec_per_chip",
+            "value": 0.0, "unit": "rows/s/chip", "vs_baseline": 0.0,
+            "detail": {"backend": "none", "backend_error": errors}}
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -471,5 +715,10 @@ if __name__ == "__main__":
     p.add_argument("--rows", type=int, default=1 << 24)
     p.add_argument("--iters", type=int, default=3)
     p.add_argument("--join-only", action="store_true")
+    p.add_argument("--in-process", action="store_true",
+                   help="skip the subprocess armor (debugging/children)")
     a = p.parse_args()
-    print(json.dumps(run(a.rows, a.iters, full=not a.join_only)))
+    if a.in_process:
+        print(json.dumps(run(a.rows, a.iters, full=not a.join_only)))
+    else:
+        print(json.dumps(_armored_main(a)))

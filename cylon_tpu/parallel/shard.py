@@ -102,7 +102,14 @@ def distribute(table: Table, ctx: CylonContext) -> Table:
         if c.validity is not None:
             validity = jax.device_put(_pad_to(c.validity, total, False), sharding)
         cols.append(Column(data, c.dtype, validity, c.dictionary, c.name))
-    mask = jax.device_put(_pad_to(table.emit_mask(), total, False), sharding)
+    if table.row_mask is None and total == n:
+        # no padding, all rows live: preserve mask-None — downstream
+        # routing reads "row_mask is None" as the dense invariant (the
+        # count-free fused world-1 exchange keys on it)
+        mask = None
+    else:
+        mask = jax.device_put(_pad_to(table.emit_mask(), total, False),
+                              sharding)
     return Table(cols, ctx, mask)
 
 
@@ -353,3 +360,51 @@ def assemble_process_local(tables, ctx: CylonContext) -> Table:
         cols.append(Column(data, ref.dtype, validity, None, ref.name))
     emit = build([np.ones(t.capacity, np.bool_) for t in tables], False)
     return Table(cols, ctx, emit)
+
+
+def _local_blocks(arr) -> list:
+    """This process's shards of a row-sharded array, as numpy blocks in
+    global shard order."""
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: (s.index[0].start or 0) if s.index else 0)
+    return [np.asarray(s.data) for s in shards]
+
+
+def extract_process_local(table: Table, ctx: CylonContext) -> dict:
+    """Host numpy dict of THIS process's shards' live rows — the
+    per-process handoff out of a distributed table (the export mirror of
+    `assemble_process_local`). Each controller process of a multi-host
+    mesh gets exactly its own shards, so a DDP training loop can feed
+    its accelerator without any global gather (reference:
+    demo_pytorch_distributed.py:1-50 feeds each rank its pycylon
+    partition; python/examples/cylon_sequential_mnist.py).
+
+    Fixed-width and dictionary columns only: varbytes buffers are
+    word-sharded separately from rows — export those via per-rank
+    write_csv instead."""
+    t = table
+    n_local = None
+    out = {}
+    for name, c in zip(t._unique_names(), t._columns):
+        if c.is_varbytes:
+            raise CylonError(
+                Code.NotImplemented,
+                "varbytes columns: export via per-rank write_csv (word "
+                "buffers are sharded separately from rows)")
+        d = np.concatenate(_local_blocks(c.data))
+        n_local = d.shape[0]
+        vals = c.dictionary[d].astype(object) if c.is_string else d
+        if c.validity is not None:
+            m = np.concatenate(_local_blocks(c.validity))
+            if vals.dtype.kind == "f":
+                vals = vals.copy()
+                vals[~m] = np.nan
+            else:
+                vals = vals.astype(object)
+                vals[~m] = None
+        out[name] = vals
+    if t.row_mask is not None:
+        em = np.concatenate(_local_blocks(t.row_mask))
+    else:
+        em = np.ones(n_local if n_local is not None else 0, bool)
+    return {k: v[em] for k, v in out.items()}

@@ -73,7 +73,9 @@ def main(log2n: int = 24) -> dict:
         np.asarray(jax.device_get(cf(targets, emit)))
     res["count_plus_fetch_s"] = best_of(count_sync)
 
-    # phase 3: exchange program alone (counts precomputed)
+    # phase 3: exchange program alone with precomputed counts — at W=1
+    # this routes through the COUNTED (bucket-sort) path, i.e. the
+    # pre-round-5 behavior; kept as the floor comparison
     counts = np.asarray(jax.device_get(cf(targets, emit)))
 
     def exchange_only():
@@ -82,10 +84,24 @@ def main(log2n: int = 24) -> dict:
         sync(out)
     res["exchange_program_s"] = best_of(exchange_only)
 
-    # end to end (count + sync + exchange)
+    # phase 3b: the bucket-sort FLOOR — one stable multi-operand sort of
+    # the same operand set, nothing else. If exchange_program_s ≈
+    # sort_floor_s, the counted exchange is sort-bound and the fused
+    # world-1 identity path (below) is the only way past it
+    tkey = jnp.where(emit, targets.astype(jnp.int32), world)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    sort_fn = jax.jit(lambda tk, ops: jax.lax.sort(
+        (tk,) + tuple(ops) + (iota,), num_keys=1, is_stable=True))
+
+    def sort_floor():
+        sync(sort_fn(tkey, tuple(payload.values())))
+    res["sort_floor_s"] = best_of(sort_floor)
+
+    # end to end, default routing (round-5: at W=1 this is the FUSED
+    # count+exchange — in-program counts, device-side all-live identity)
     def full():
         out, new_emit, _cap, _meta = _shuffle.exchange(
-            payload, targets, emit, ctx)
+            payload, targets, emit, ctx, dense=True)
         sync(out)
     res["end_to_end_s"] = best_of(full)
 

@@ -553,3 +553,87 @@ def test_hash_partition_device_resident_with_strings(local_ctx, monkeypatch):
             assert seen.setdefault(kk, pid) == pid
         all_rows += list(zip(df["k"], df["v"]))
     assert sorted(all_rows) == sorted(zip(keys, range(n)))
+
+
+# ---------------------------------------------------------------------------
+# round-5: fused world-1 exchange (count-free, device-side identity when
+# dense) + the dense routing gate
+# ---------------------------------------------------------------------------
+
+def test_world1_fused_exchange_skips_count(monkeypatch):
+    """Dense 1-wide-mesh shuffles must never pay the host count sync:
+    counts compute in-program (VERDICT r04 #4b). Masked tables keep the
+    counted route (pow2(live) capacity beats saving one sync)."""
+    import jax
+
+    from cylon_tpu.ops.join import JoinConfig
+    from cylon_tpu.parallel import shuffle as _shuffle
+
+    counted = {"n": 0}
+    orig1, orig2 = _shuffle._count_fn, _shuffle._count2_fn
+
+    def spy1(mesh):
+        counted["n"] += 1
+        return orig1(mesh)
+
+    def spy2(mesh):
+        counted["n"] += 1
+        return orig2(mesh)
+
+    monkeypatch.setattr(_shuffle, "_count_fn", spy1)
+    monkeypatch.setattr(_shuffle, "_count2_fn", spy2)
+    ctx1 = ct.CylonContext.InitDistributed(
+        ct.TPUConfig(devices=(jax.devices()[0],)))
+    rng = np.random.default_rng(0)
+    n = 2048  # pow2: distribute adds no padding, row_mask stays None
+    left = ct.Table.from_pydict(ctx1, {
+        "k": rng.integers(0, 500, n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32)})
+    right = ct.Table.from_pydict(ctx1, {
+        "k": rng.integers(0, 500, n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32)})
+    assert left.row_mask is None and right.row_mask is None
+
+    dj = dist_ops.distributed_join(left, right,
+                                   JoinConfig.InnerJoin([0], [0]),
+                                   force_exchange=True)
+    assert dj.row_count == left.join(right, "inner", on="k").row_count
+    assert counted["n"] == 0, "dense w1 join must not run a count program"
+
+    s = dist_ops.distributed_sort(left, "k", force_exchange=True)
+    assert np.array_equal(np.asarray(s.to_pydict()["k"]),
+                          np.sort(np.asarray(left.to_pydict()["k"])))
+    assert counted["n"] == 0, "dense w1 sort must not run a count program"
+
+    # masked input: counted route engages (dense gate)
+    fm = left.filter_mask(left._columns[0].data < 100)
+    dj2 = dist_ops.distributed_join(fm, right,
+                                    JoinConfig.InnerJoin([0], [0]),
+                                    force_exchange=True)
+    assert dj2.row_count == fm.join(right, "inner", on="k").row_count
+    assert counted["n"] >= 1
+
+
+def test_world1_fused_exchange_dead_rows(monkeypatch):
+    """The fused body's device-side cond: dead rows route through the
+    compaction sort branch and come out dropped, in stable order."""
+    import jax
+    import jax.numpy as jnp
+
+    from cylon_tpu.parallel import shard as _shard
+    from cylon_tpu.parallel.shuffle import exchange
+
+    ctx1 = ct.CylonContext.InitDistributed(
+        ct.TPUConfig(devices=(jax.devices()[0],)))
+    n = 512
+    rng = np.random.default_rng(1)
+    a = rng.integers(0, 1 << 30, n).astype(np.int32)
+    emit = np.ones(n, bool)
+    emit[::3] = False
+    out, ne, cap, meta = exchange(
+        {"a": _shard.pin(jnp.asarray(a), ctx1)},
+        _shard.pin(jnp.zeros(n, np.int32), ctx1),
+        _shard.pin(jnp.asarray(emit), ctx1), ctx1, dense=True)
+    got = np.asarray(out["a"])[np.asarray(ne)]
+    assert np.array_equal(got, a[emit]), "stable live-prefix compaction"
+    assert meta["mode"] == "padded" and cap == 512

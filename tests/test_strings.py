@@ -549,7 +549,7 @@ def test_binary_min_max_returns_bytes(ctx):
     assert t.min(0).to_pydict()["b"][0] == b"aa"
 
 
-def test_exact_join_rejects_forced_hash_collision(ctx, monkeypatch):
+def test_exact_join_survives_forced_hash_collision(ctx, monkeypatch):
     """VERDICT #4: exact=True re-checks true bytes for LONG keys (>
     EXACT_KEY_WORDS words, which join on the 96-bit content hash).
     Force every hash to collide: the default join merges distinct keys
@@ -578,9 +578,68 @@ def test_exact_join_rejects_forced_hash_collision(ctx, monkeypatch):
         pd.DataFrame({"k": rk, "w": np.arange(40)}), on="k")
     assert len(got) == len(exp) == 20
     assert sorted(got.iloc[:, 0]) == sorted(exp["k"])
-    # outer joins raise instead of silently reclassifying
-    with pytest.raises(Exception):
-        lt.join(rt, "left", on="k", exact=True)
+    # outer joins reclassify false matches as unmatched via the
+    # shared-vocabulary dictionary fallback (round-5: VERDICT r04 #8 —
+    # the old behavior raised)
+    ldf = pd.DataFrame({"k": lk, "v": np.arange(40)})
+    rdf = pd.DataFrame({"k": rk, "w": np.arange(40)})
+    for jt, how in (("left", "left"), ("right", "right"),
+                    ("outer", "outer")):
+        g = lt.join(rt, jt, on="k", exact=True).to_pandas()
+        e = ldf.merge(rdf, on="k", how=how)
+        assert len(g) == len(e), (jt, len(g), len(e))
+        # matched-row multiset is exact: (k, v, w) for rows present on
+        # both sides
+        gm = g.dropna(subset=[g.columns[1], g.columns[-1]])
+        gset = sorted(zip(gm[g.columns[0]], gm[g.columns[1]].astype(int),
+                          gm[g.columns[-1]].astype(int)))
+        em = e.dropna()
+        eset = sorted(zip(em["k"], em["v"].astype(int),
+                          em["w"].astype(int)))
+        assert gset == eset, jt
+
+
+def test_exact_distributed_join_long_keys(dist_ctx, monkeypatch):
+    """Round-5 (VERDICT r04 #8): exact=True on DISTRIBUTED long-key
+    joins byte-verifies after the exchange instead of rejecting. With
+    every content hash forced to collide, INNER filters the false
+    matches on device and LEFT redoes the join on shared-vocabulary
+    dictionary codes."""
+    from cylon_tpu.ops.join import JoinConfig, JoinType
+    from cylon_tpu.parallel import dist_ops
+
+    _force_varbytes(monkeypatch)
+
+    def colliding_hash(words, starts, lengths, max_words):
+        import jax.numpy as jnp
+        n = starts.shape[0]
+        h = jnp.full(n, jnp.uint32(0xC0FFEE))
+        return h, h, h
+
+    monkeypatch.setattr(_strings, "_hash_rows", colliding_hash)
+    lk = np.array([f"{'L' * 26}{i:04d}" for i in range(40)], object)
+    rk = np.array([f"{'L' * 26}{i:04d}" for i in range(0, 80, 2)], object)
+    lt = ct.Table.from_pydict(dist_ctx, {"k": lk,
+                                         "v": np.arange(40, dtype=np.int32)})
+    rt = ct.Table.from_pydict(dist_ctx, {"k": rk,
+                                         "w": np.arange(40, dtype=np.int32)})
+    assert lt.get_column(0).varbytes.max_words > _strings.EXACT_KEY_WORDS
+
+    exp = pd.DataFrame({"k": lk, "v": np.arange(40)}).merge(
+        pd.DataFrame({"k": rk, "w": np.arange(40)}), on="k")
+    cfg = JoinConfig(JoinType.INNER, [0], [0], exact=True)
+    j = dist_ops.distributed_join(lt, rt, cfg,
+                                  force_exchange=True).to_pandas()
+    assert len(j) == len(exp) == 20
+    assert sorted(j.iloc[:, 0]) == sorted(exp["k"])
+
+    cfg = JoinConfig(JoinType.LEFT, [0], [0], exact=True)
+    j = dist_ops.distributed_join(lt, rt, cfg,
+                                  force_exchange=True).to_pandas()
+    assert len(j) == 40
+    gm = j.dropna(subset=[j.columns[-1]])
+    assert len(gm) == 20
+    assert sorted(gm.iloc[:, 0]) == sorted(exp["k"])
 
 
 def test_lane_paths_edge_shapes(ctx, monkeypatch):

@@ -1,0 +1,26 @@
+"""The distributed-training story in CI (VERDICT r04 #10): 2 real
+controller processes run distributed ETL on a multi-host mesh, hand each
+process ITS shards via Table.to_pydict_local, and train a torch DDP
+model over gloo — the reference's demo_pytorch_distributed.py:1-50 flow
+on the TPU-native stack."""
+import os
+import sys
+
+import pytest
+
+# multi-process (slow spawn + compile): excluded from the quick tier
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_ddp_demo():
+    sys.path.insert(0, os.path.join(_REPO, "examples"))
+    try:
+        import torch_ddp_demo
+    finally:
+        sys.path.pop(0)
+    outs = torch_ddp_demo.launch(nproc=2)
+    for pid, out in enumerate(outs):
+        assert f"DDPOK {pid}" in out
+        assert "epoch 1" in out
