@@ -1,0 +1,50 @@
+"""bench.py is the driver-facing artifact producer — its code paths are
+gated here so a refactor can't silently sink a round's evidence again
+(round-4 postmortem: BENCH_r04 was rc=1/parsed=null)."""
+import json
+
+import numpy as np
+import pytest
+
+import bench
+
+
+def test_last_json_line_parses_noise():
+    noisy = ("WARNING: platform experimental\n"
+             "{\"not\": \"last\"}\n"
+             "progress 50%\n"
+             '{"metric": "x", "value": 1.5}\n')
+    assert bench._last_json_line(noisy) == {"metric": "x", "value": 1.5}
+    assert bench._last_json_line("no json here") is None
+    assert bench._last_json_line("{broken\n") is None
+
+
+def test_run_join_only_small(local_ctx):
+    """The primary metric path end-to-end at tiny scale: valid artifact
+    shape, real numbers, never parsed-null material."""
+    res = bench.run(1 << 10, iters=1, full=False)
+    assert res["metric"] == "dist_inner_join_rows_per_sec_per_chip"
+    assert res["value"] > 0
+    assert res["unit"] == "rows/s/chip"
+    assert isinstance(res["vs_baseline"], float)
+    d = res["detail"]
+    assert d["out_rows"] > 0
+    assert d["local_inner_join"]["rows_per_s_per_chip"] > 0
+    assert d["shuffle"]["rows_per_s_per_chip"] > 0
+    json.dumps(res)  # one-line artifact must be serializable
+
+
+@pytest.mark.slow
+def test_full_suite_small(local_ctx):
+    """Every suite config produces a number (no error keys) at small
+    scale — the round-4 'one failing config sinks the artifact' guard
+    plus the round-5 configs (dist_string_join, dist_sort,
+    pandas_reference)."""
+    res = bench.run(1 << 12, iters=1, full=True)
+    suite = res["detail"]["suite"]
+    for name in ("groupby_agg", "global_sort", "set_union", "q5_pipeline",
+                 "string_join", "dist_string_join", "dist_sort",
+                 "shuffle_wide", "hbm_blocked_join", "pandas_reference"):
+        assert name in suite, f"missing config {name}"
+        assert "error" not in suite[name], (name, suite[name])
+    json.dumps(res)
