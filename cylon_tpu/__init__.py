@@ -18,7 +18,7 @@ from .data.row import Row
 from .data.table import Table, concat_tables, join, set_op
 from .dtypes import DataType, Layout, Type
 from .io.csv import read_csv, read_csv_per_rank, write_csv
-from .io.parquet import read_parquet, write_parquet
+from .io.parquet import read_parquet, read_parquet_per_rank, write_parquet
 from .ops.groupby import AggregationOp
 from .ops.join import JoinAlgorithm, JoinConfig, JoinType
 from . import native
@@ -41,6 +41,7 @@ __all__ = [
     "distributed_join_ring", "distributed_set_op",
     "distributed_sort", "hash_partition", "join", "native", "read_csv",
     "read_csv_per_rank",
-    "read_parquet", "repartition", "set_op", "shuffle", "telemetry",
+    "read_parquet", "read_parquet_per_rank", "repartition", "set_op",
+    "shuffle", "telemetry",
     "write_csv", "write_parquet",
 ]

@@ -23,6 +23,29 @@ def read_parquet(ctx: CylonContext, path: Union[str, Sequence[str]],
     return Table.from_arrow(ctx, pa_table)
 
 
+def read_parquet_per_rank(ctx: CylonContext, path_pattern: str,
+                          options: Optional[ParquetOptions] = None
+                          ) -> Table:
+    """Per-rank parquet placement — ``path_pattern`` contains ``{rank}``,
+    substituted with each shard index (the per-rank file convention
+    read_csv_per_rank implements for CSV; reference:
+    cpp/test/join_test.cpp:22-24). Multi-host: each controller process
+    reads only the shards it owns; collective, all processes must call
+    it."""
+    import pyarrow.parquet as pq
+
+    from ..parallel import shard as _shard
+
+    tables = []
+    for i in ctx.local_shard_indices():
+        p = path_pattern.format(rank=i)
+        try:
+            tables.append(Table.from_arrow(ctx, pq.read_table(p)))
+        except FileNotFoundError as e:
+            raise CylonError(Code.IOError, str(e))
+    return _shard.assemble_process_local(tables, ctx)
+
+
 def write_parquet(table: Table, path: str,
                   options: Optional[ParquetOptions] = None) -> None:
     import pyarrow.parquet as pq

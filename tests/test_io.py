@@ -132,3 +132,23 @@ def test_dataloader_partitions(tmp_path):
 
     with pytest.raises(Exception):
         DataLoader(ctx, str(tmp_path), ["nope.csv"])
+
+
+def test_read_parquet_per_rank(dist_ctx, tmp_path):
+    """Per-rank parquet placement mirrors read_csv_per_rank: shard i of
+    the assembled table holds file i's rows."""
+    rng = np.random.default_rng(3)
+    world = dist_ctx.get_world_size()
+    per = 100
+    all_k = []
+    for i in range(world):
+        k = rng.integers(0, 1000, per).astype(np.int64)
+        all_k.append(k)
+        t = ct.Table.from_pydict(dist_ctx, {"k": k})
+        t.to_parquet(str(tmp_path / f"p_{i}.parquet"))
+    out = ct.read_parquet_per_rank(dist_ctx,
+                                   str(tmp_path / "p_{rank}.parquet"))
+    assert out.row_count == per * world
+    got = np.asarray(out.to_pydict()["k"])
+    assert np.array_equal(np.sort(got),
+                          np.sort(np.concatenate(all_k)))
