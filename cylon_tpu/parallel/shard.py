@@ -379,18 +379,33 @@ def extract_process_local(table: Table, ctx: CylonContext) -> dict:
     demo_pytorch_distributed.py:1-50 feeds each rank its pycylon
     partition; python/examples/cylon_sequential_mnist.py).
 
-    Fixed-width and dictionary columns only: varbytes buffers are
-    word-sharded separately from rows — export those via per-rank
-    write_csv instead."""
+    Varbytes columns decode per shard: their starts are SHARD-RELATIVE
+    by invariant (strings.py shard_geom), so each addressable word block
+    pairs with its row block with no global gather."""
+    from ..dtypes import Type
+
     t = table
     n_local = None
     out = {}
     for name, c in zip(t._unique_names(), t._columns):
         if c.is_varbytes:
-            raise CylonError(
-                Code.NotImplemented,
-                "varbytes columns: export via per-rank write_csv (word "
-                "buffers are sharded separately from rows)")
+            vb = c.varbytes
+            vals = []
+            for wb, sb, lb in zip(_local_blocks(vb.words),
+                                  _local_blocks(vb.starts),
+                                  _local_blocks(vb.lengths)):
+                raw = np.ascontiguousarray(wb).view(np.uint8).tobytes()
+                for s, ln in zip(sb.tolist(), lb.tolist()):
+                    b = raw[4 * s: 4 * s + ln]
+                    vals.append(b if c.dtype.type == Type.BINARY
+                                else b.decode("utf-8", errors="replace"))
+            vals = np.array(vals, dtype=object)
+            n_local = vals.shape[0]
+            if c.validity is not None:
+                m = np.concatenate(_local_blocks(c.validity))
+                vals[~m] = None
+            out[name] = vals
+            continue
         d = np.concatenate(_local_blocks(c.data))
         n_local = d.shape[0]
         vals = c.dictionary[d].astype(object) if c.is_string else d

@@ -637,3 +637,32 @@ def test_world1_fused_exchange_dead_rows(monkeypatch):
     got = np.asarray(out["a"])[np.asarray(ne)]
     assert np.array_equal(got, a[emit]), "stable live-prefix compaction"
     assert meta["mode"] == "padded" and cap == 512
+
+
+def test_to_pydict_local_roundtrip(dist_ctx):
+    """extract_process_local: single-controller processes own every
+    shard, so the local extract must equal the global content — incl.
+    varbytes string columns (per-shard decode via the shard-relative
+    starts invariant)."""
+    from cylon_tpu.data import strings as _strings
+
+    old = _strings.DICT_MAX_VOCAB
+    _strings.DICT_MAX_VOCAB = 0
+    try:
+        rng = np.random.default_rng(5)
+        n = 512
+        sk = np.array([f"name{int(x):06d}" for x in
+                       rng.integers(0, 10_000, n)], object)
+        t = distribute(ct.Table.from_pydict(dist_ctx, {
+            "k": rng.integers(0, 100, n).astype(np.int32),
+            "s": sk,
+            "v": rng.normal(size=n).astype(np.float32)}), dist_ctx)
+        assert t._columns[1].is_varbytes
+        local = t.to_pydict_local()
+        glob = t.to_pydict()
+        for key in glob:
+            a = sorted(map(str, np.asarray(local[key]).tolist()))
+            b = sorted(map(str, np.asarray(glob[key]).tolist()))
+            assert a == b, key
+    finally:
+        _strings.DICT_MAX_VOCAB = old
