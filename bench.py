@@ -285,6 +285,35 @@ def bench_setops(ctx, n_rows: int, iters: int) -> dict:
             "wall_s_best": round(best, 4)}
 
 
+def bench_dist_union(ctx, n_rows: int, iters: int) -> dict:
+    """The honest DISTRIBUTED set-op composition, forced even on a
+    1-wide mesh: shuffle-two-tables on all columns + per-shard union
+    (the reference's DistributedUnion shape, table.cpp:948-1010)."""
+    import cylon_tpu as ct
+    from cylon_tpu.ops.setops import SetOp
+    from cylon_tpu.parallel import dist_ops
+
+    rng = np.random.default_rng(6)
+    a = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n_rows, n_rows).astype(np.int32),
+        "g": rng.integers(0, 1 << 20, n_rows).astype(np.int32),
+    })
+    b = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n_rows, n_rows).astype(np.int32),
+        "g": rng.integers(0, 1 << 20, n_rows).astype(np.int32),
+    })
+
+    def one():
+        u = dist_ops.distributed_set_op(a, b, SetOp.UNION,
+                                        force_exchange=True)
+        _sync(u)
+
+    best = _time(one, iters)
+    world = max(ctx.get_world_size(), 1)
+    return {"rows_per_s_per_chip": 2 * n_rows / best / world,
+            "wall_s_best": round(best, 4)}
+
+
 def bench_string_join(ctx, n_rows: int, iters: int) -> dict:
     """Varbytes string-key join: device content-hash identity, no host
     vocabulary (the high-cardinality ETL case)."""
@@ -434,6 +463,8 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
             ("groupby_agg", lambda: bench_groupby(ctx, n_rows, iters)),
             ("global_sort", lambda: bench_sort(ctx, n_rows, iters)),
             ("set_union", lambda: bench_setops(ctx, n_rows // 2, iters)),
+            ("dist_union",
+             lambda: bench_dist_union(ctx, n_rows // 2, iters)),
             ("q5_pipeline",
              lambda: bench_q5_pipeline(ctx, n_rows // 2, iters)),
             ("string_join",

@@ -1383,10 +1383,13 @@ def distributed_join_ring(left: Table, right: Table,
 # table.cpp:948-1010 — ShuffleTwoTables on ALL columns + local set op)
 # ---------------------------------------------------------------------------
 
-def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
+def distributed_set_op(left: Table, right: Table, op: _setops.SetOp,
+                       force_exchange: bool = False) -> Table:
+    """``force_exchange``: run the full shuffle+set-op composition even
+    on a 1-wide mesh (bench contract, same as distributed_join)."""
     ctx = left._ctx
     world = ctx.get_world_size()
-    if world == 1:
+    if world == 1 and not (force_exchange and ctx.is_distributed()):
         return table_mod.set_op(left, right, op)
     if left.column_count != right.column_count:
         raise CylonError(Code.Invalid, "set ops need equal schemas")
@@ -1416,11 +1419,17 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp) -> Table:
                 _partition_targets_dist(ctx, cols, other), ctx)
             emit = shard.pin(t.emit_mask(), ctx)
             sides.append((view, targets, emit))
-        cl, cr = count_pair(sides[0][1], sides[0][2],
-                            sides[1][1], sides[1][2], ctx)
+        # 1-wide mesh + dense emits: count-free fused route (round-5)
+        dense = (world == 1 and left_d.row_mask is None
+                 and right_d.row_mask is None)
+        cl = cr = None
+        if not dense:
+            cl, cr = count_pair(sides[0][1], sides[0][2],
+                                sides[1][1], sides[1][2], ctx)
         for (view, targets, emit), cnt in zip(sides, (cl, cr)):
             out_cols, emit_s, _x = _exchange_table(view, targets, emit,
-                                                   ctx, counts=cnt)
+                                                   ctx, counts=cnt,
+                                                   dense=dense)
             shuffled.append((emit_s, out_cols))
 
     (lemit, lcols_s), (remit, rcols_s) = shuffled

@@ -43,7 +43,7 @@ def test_full_suite_small(local_ctx):
     res = bench.run(1 << 12, iters=1, full=True)
     suite = res["detail"]["suite"]
     for name in ("groupby_agg", "global_sort", "set_union", "q5_pipeline",
-                 "string_join", "dist_string_join", "dist_sort",
+                 "string_join", "dist_string_join", "dist_sort", "dist_union",
                  "shuffle_wide", "hbm_blocked_join", "pandas_reference"):
         assert name in suite, f"missing config {name}"
         assert "error" not in suite[name], (name, suite[name])
