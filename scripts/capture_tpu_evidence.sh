@@ -1,0 +1,40 @@
+#!/bin/sh
+# Round-5 evidence capture: run the real-TPU tiers and profiles once the
+# chip is healthy. Each step is independently logged and failures don't
+# stop later steps (the round-4 lesson: one dead step must not sink the
+# rest of the evidence).
+#
+# Usage: sh scripts/capture_tpu_evidence.sh [logdir=/tmp/tpu_evidence]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/tpu_evidence}
+mkdir -p "$LOG"
+
+probe() {
+    timeout 120 python -c "import jax, jax.numpy as jnp; \
+print(len(jax.devices()), jax.devices()[0].platform, \
+int(jnp.arange(10).sum()))" 2>&1 | tail -1
+}
+
+echo "== probe: $(probe)"
+
+run_step() {
+    name=$1; shift
+    echo "== $name: $*"
+    ( timeout "$STEP_TIMEOUT" "$@" > "$LOG/$name.out" 2> "$LOG/$name.err" )
+    rc=$?
+    echo "== $name rc=$rc ($(tail -c 200 "$LOG/$name.out" | tr '\n' ' '))"
+}
+
+STEP_TIMEOUT=3600
+run_step tpu_tests sh scripts/run_tpu_tests.sh
+run_step bench python bench.py
+run_step profile_shuffle python scripts/profile_shuffle.py 24
+run_step profile_groupby python scripts/profile_groupby.py 24 20
+run_step profile_dist_join python scripts/profile_dist_join.py 24
+run_step compare python scripts/compare_competitors.py 22
+
+echo "== artifacts:"
+ls -la TPU_TESTS.json PROFILE_*.json COMPARE.json 2>/dev/null
+echo "== bench line:"
+tail -1 "$LOG/bench.out" 2>/dev/null
