@@ -1266,7 +1266,8 @@ def groupby_local(table: Table, index_col, aggregate_cols: List,
             keys.append(c.valid_mask().astype(jnp.uint8))
     emit = table.emit_mask()
     values = tuple(table._columns[i].data for i in val_cols)
-    valids = tuple(table._columns[i].valid_mask() for i in val_cols)
+    # None for all-valid columns: the mask never rides the sort
+    valids = tuple(table._columns[i].validity for i in val_cols)
     # ONE fused sort groups rows contiguously (dead rows last); the
     # n_groups fetch below is the op's single host sync, and every
     # segment reduction then runs on SORTED ids — see

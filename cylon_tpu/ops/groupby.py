@@ -81,6 +81,9 @@ def presort_groups(keys: Tuple[jnp.ndarray, ...], emit: jnp.ndarray,
     old path paid (a ~15-30 ns/element .at[perm].set at full row count)
     disappears entirely.
 
+    ``valids`` entries may be None (all-valid column): None masks don't
+    ride the sort — the aggregate reads them as "live row = valid".
+
     Returns (values_s, valids_s, emit_s, iota_s, gid_s, n_groups) where
     gid_s is the per-SORTED-row dense group id and n_groups a device
     scalar (the caller's single host sync)."""
@@ -88,12 +91,14 @@ def presort_groups(keys: Tuple[jnp.ndarray, ...], emit: jnp.ndarray,
     dead = (~emit).astype(jnp.uint8)
     iota = jnp.arange(n, dtype=jnp.int32)
     nk, nv = len(keys), len(values)
-    ops_in = (dead,) + tuple(keys) + tuple(values) + tuple(valids) \
+    real_v = [v for v in valids if v is not None]
+    ops_in = (dead,) + tuple(keys) + tuple(values) + tuple(real_v) \
         + (emit, iota)
     res = jax.lax.sort(ops_in, num_keys=1 + nk, is_stable=True)
     ks = res[1:1 + nk]
     values_s = tuple(res[1 + nk:1 + nk + nv])
-    valids_s = tuple(res[1 + nk + nv:1 + nk + 2 * nv])
+    it = iter(res[1 + nk + nv:1 + nk + nv + len(real_v)])
+    valids_s = tuple(None if v is None else next(it) for v in valids)
     emit_s, iota_s = res[-2], res[-1]
     # row differs from its predecessor on any key lane (row 0 = True);
     # dead rows are all last, so live rows form a prefix and cumsum
@@ -151,7 +156,7 @@ def sorted_segment_aggregate(gid_s, emit_s, iota_s,
     results = []
     for arr, vmask, op, cid, av in zip(values_s, valids_s, ops, col_ids,
                                        all_valid):
-        use = emit_s & vmask
+        use = emit_s if vmask is None else (emit_s & vmask)
         vkey = "all" if av else cid
         count = lambda: memo(("count", vkey), lambda: seg_sum(
             use.astype(jnp.int64))[:num_segments])

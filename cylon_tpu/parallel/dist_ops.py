@@ -1529,7 +1529,8 @@ def _groupby_shuffle_agg(ctx: CylonContext, key_columns, value_columns,
     kdat = tuple(shard.pin(c.data, ctx) for c in kcols_s)
     kval = tuple(shard.pin(c.valid_mask(), ctx) for c in kcols_s)
     vdat = tuple(shard.pin(c.data, ctx) for c in vcols_s)
-    vval = tuple(shard.pin(c.valid_mask(), ctx) for c in vcols_s)
+    vval = tuple(None if c.validity is None
+                 else shard.pin(c.valid_mask(), ctx) for c in vcols_s)
 
     with _phase("distributed_groupby.aggregate", seq):
         if col_ids is None:
@@ -1623,7 +1624,8 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
             src = t._columns[val_cols[j]]
             d = src.data.astype(jnp.float64) if cast else src.data
             vdatA.append(shard.pin(d, ctx))
-            vvalA.append(shard.pin(src.valid_mask(), ctx))
+            vvalA.append(None if src.validity is None
+                         else shard.pin(src.valid_mask(), ctx))
         opsA = tuple(opA for _j, opA, _c in a_entries)
         cidsA = tuple((val_cols[j], cast) for j, _opA, cast in a_entries)
         avA = tuple(t._columns[val_cols[j]].validity is None

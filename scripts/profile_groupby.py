@@ -53,7 +53,7 @@ def main(log2n: int = 24, log2g: int = 20) -> dict:
     keys = tuple(_order.sort_keys([t._columns[0]]))
     emit = t.emit_mask()
     values = (t._columns[1].data, t._columns[2].data, t._columns[1].data)
-    valids = tuple(jnp.ones(n, bool) for _ in range(3))
+    valids = (None, None, None)  # all-valid: masks never ride the sort
     ops = (_groupby.AggregationOp.SUM, _groupby.AggregationOp.COUNT,
            _groupby.AggregationOp.MEAN)
 
