@@ -641,6 +641,18 @@ def test_exact_distributed_join_long_keys(dist_ctx, monkeypatch):
     assert len(gm) == 20
     assert sorted(gm.iloc[:, 0]) == sorted(exp["k"])
 
+    ldf = pd.DataFrame({"k": lk, "v": np.arange(40)})
+    rdf = pd.DataFrame({"k": rk, "w": np.arange(40)})
+    for jt, how in ((JoinType.RIGHT, "right"),
+                    (JoinType.FULL_OUTER, "outer")):
+        cfg = JoinConfig(jt, [0], [0], exact=True)
+        j = dist_ops.distributed_join(lt, rt, cfg,
+                                      force_exchange=True).to_pandas()
+        e = ldf.merge(rdf, on="k", how=how)
+        assert len(j) == len(e), (how, len(j), len(e))
+        gm = j.dropna(subset=[j.columns[1], j.columns[-1]])
+        assert len(gm) == len(e.dropna()), how
+
 
 def test_lane_paths_edge_shapes(ctx, monkeypatch):
     """Empty/one-row/all-empty-string tables through the word-lane
