@@ -625,8 +625,9 @@ def test_exact_distributed_join_long_keys(dist_ctx, monkeypatch):
                                          "w": np.arange(40, dtype=np.int32)})
     assert lt.get_column(0).varbytes.max_words > _strings.EXACT_KEY_WORDS
 
-    exp = pd.DataFrame({"k": lk, "v": np.arange(40)}).merge(
-        pd.DataFrame({"k": rk, "w": np.arange(40)}), on="k")
+    ldf = pd.DataFrame({"k": lk, "v": np.arange(40)})
+    rdf = pd.DataFrame({"k": rk, "w": np.arange(40)})
+    exp = ldf.merge(rdf, on="k")
     cfg = JoinConfig(JoinType.INNER, [0], [0], exact=True)
     j = dist_ops.distributed_join(lt, rt, cfg,
                                   force_exchange=True).to_pandas()
@@ -641,8 +642,6 @@ def test_exact_distributed_join_long_keys(dist_ctx, monkeypatch):
     assert len(gm) == 20
     assert sorted(gm.iloc[:, 0]) == sorted(exp["k"])
 
-    ldf = pd.DataFrame({"k": lk, "v": np.arange(40)})
-    rdf = pd.DataFrame({"k": rk, "w": np.arange(40)})
     for jt, how in ((JoinType.RIGHT, "right"),
                     (JoinType.FULL_OUTER, "outer")):
         cfg = JoinConfig(jt, [0], [0], exact=True)
@@ -651,7 +650,14 @@ def test_exact_distributed_join_long_keys(dist_ctx, monkeypatch):
         e = ldf.merge(rdf, on="k", how=how)
         assert len(j) == len(e), (how, len(j), len(e))
         gm = j.dropna(subset=[j.columns[1], j.columns[-1]])
-        assert len(gm) == len(e.dropna()), how
+        em = e.dropna()
+        assert len(gm) == len(em), how
+        # matched rows byte-correct, not just counted: (k, v, w) triples
+        gset = sorted(zip(gm.iloc[:, 0], gm.iloc[:, 1].astype(int),
+                          gm.iloc[:, -1].astype(int)))
+        eset = sorted(zip(em["k"], em["v"].astype(int),
+                          em["w"].astype(int)))
+        assert gset == eset, how
 
 
 def test_lane_paths_edge_shapes(ctx, monkeypatch):
