@@ -1828,7 +1828,18 @@ def distributed_sort(table: Table, order_by, ascending=True,
     with _phase("distributed_sort.partition", seq):
         lanes = [shard.pin(l, ctx) for l in lanes]
         emit = shard.pin(t.emit_mask(), ctx)
-        splitters = _range_splitters(ctx, lanes, emit)
+        # splitter memoization (the count-cache pattern, weakref-keyed
+        # on the SOURCE column buffers): repeat sorts of the same table
+        # skip the ~100 ms sample fetch — the lanes themselves are fresh
+        # derived arrays every call, so the key is the source data
+        from .shuffle import _count_cached
+
+        src_refs = tuple(c.data for c in order_cols) + \
+            ((t.row_mask,) if t.row_mask is not None else ())
+        splitters = _count_cached(
+            ("splitters", id(ctx.mesh), tuple(asc), world)
+            + tuple(id(r) for r in src_refs),
+            src_refs, lambda: _range_splitters(ctx, lanes, emit))
         targets = _splitter_targets(lanes, splitters)
         cols_s, emit_s, _x = _exchange_table(
             t, shard.pin(targets, ctx), emit, ctx,
