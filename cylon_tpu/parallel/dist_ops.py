@@ -1262,12 +1262,11 @@ def distributed_join_ring(left: Table, right: Table,
                           config.right_column_idx):
             kw = _pair_k(left._columns[li], right._columns[rj])
             if kw is not None and kw > EXACT_KEY_WORDS:
-                raise CylonError(
-                    Code.NotImplemented,
-                    "exact=True on ring joins with long varbytes keys "
-                    "is not supported; dictionary-encode the key column "
-                    f"(keys up to {EXACT_KEY_WORDS * 4} bytes are "
-                    "byte-exact by default)")
+                # the ring can't byte-verify mid-rotation; the shuffle
+                # path post-verifies (round-5) — route there rather
+                # than reject (keys <= EXACT_KEY_WORDS*4 bytes are
+                # byte-exact on the ring by construction)
+                return distributed_join(left, right, config)
 
     left_d = shard.distribute(left, ctx)
     right_d = shard.distribute(right, ctx)
