@@ -842,6 +842,7 @@ def hash_partition(table: Table, hash_columns: Sequence,
 def _hash_partition_host(table: Table, idxs, num_partitions: int) -> dict:
     """Host partitioner (native ct_row_hash) — the long-varbytes path."""
     from ..data.column import Column
+    from ..data.strings import VarBytes
 
     t = table.compact()
     host, valids, counts, order, offs = shard.host_partition_arrays(
@@ -852,8 +853,13 @@ def _hash_partition_host(table: Table, idxs, num_partitions: int) -> dict:
         cols = []
         for ci, c in enumerate(t._columns):
             v = None if valids[ci] is None else jnp.asarray(valids[ci][seg])
-            cols.append(Column(jnp.asarray(host[ci][seg]), c.dtype, v,
-                               c.dictionary, c.name))
+            if c.is_varbytes:
+                vb = VarBytes.from_host(host[ci][seg])
+                cols.append(Column(vb.lengths, c.dtype, v, None, c.name,
+                                   varbytes=vb))
+            else:
+                cols.append(Column(jnp.asarray(host[ci][seg]), c.dtype, v,
+                                   c.dictionary, c.name))
         out[p] = Table(cols, t._ctx)
     return out
 

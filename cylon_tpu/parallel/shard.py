@@ -186,23 +186,39 @@ def host_partition_arrays(t: Table, idxs, world: int):
     columns to host, run the native partitioner over its key columns,
     and return (host_cols, valids, counts, order, offsets). Used by both
     distribute_by_key and dist_ops.hash_partition so placement logic
-    lives in exactly one place."""
-    from .. import native as _native
+    lives in exactly one place.
 
+    Varbytes columns come to host as object arrays; varbytes KEY columns
+    dictionary-encode on the fly (np.unique codes, sorted vocab) so the
+    native partitioner hashes ints — the round-5 fix for the long-string
+    hash_partition fallback, which previously rejected varbytes
+    outright."""
+    from .. import native as _native
+    from ..dtypes import Type
+
+    host = []
     for c in t._columns:
         if c.is_varbytes:
-            raise CylonError(
-                Code.NotImplemented,
-                "host partitioner on varbytes columns: dictionary-encode "
-                "or use the device shuffle (distributed_join/shuffle)")
-    host = [np.asarray(jax.device_get(c.data)) for c in t._columns]
+            host.append(c.varbytes.to_host(
+                as_str=c.dtype.type != Type.BINARY))
+        else:
+            host.append(np.asarray(jax.device_get(c.data)))
     valids = [None if c.validity is None
               else np.asarray(jax.device_get(c.valid_mask()))
               for c in t._columns]
+    keys = []
+    for i in idxs:
+        if t._columns[i].is_varbytes:
+            filler = b"" if t._columns[i].dtype.type == Type.BINARY else ""
+            safe = np.array([filler if v is None else v for v in host[i]],
+                            dtype=object)
+            _vocab, codes = np.unique(safe, return_inverse=True)
+            keys.append(codes.astype(np.int32))
+        else:
+            keys.append(host[i])
     flags = [t._columns[i].is_string for i in idxs]
     _targets, counts, order = _native.hash_partition(
-        [host[i] for i in idxs], [valids[i] for i in idxs], world,
-        is_string=flags)
+        keys, [valids[i] for i in idxs], world, is_string=flags)
     offs = np.concatenate([[0], np.cumsum(counts)])
     return host, valids, counts, order, offs
 
@@ -236,6 +252,38 @@ def distribute_by_key(table: Table, ctx: CylonContext, key_columns) -> Table:
         for s in range(world):
             out[s * cap:s * cap + counts[s]] = g[offs[s]:offs[s + 1]]
         return jax.device_put(jnp.asarray(out), sharding)
+
+    if any(c.is_varbytes for c in t._columns):
+        # varbytes rows can't lift through the fixed-width build():
+        # materialize each shard's rows as a host table (VarBytes
+        # rebuilt from the partitioned object arrays) and assemble —
+        # shard i of the result holds partition i, same placement
+        from ..data.strings import VarBytes
+
+        if ctx.get_process_count() > 1:
+            raise CylonError(
+                Code.NotImplemented,
+                "multi-host distribute_by_key with varbytes columns: "
+                "use per-rank file placement (read_csv_per_rank)")
+
+        shard_tables = []
+        for s in range(world):
+            seg = order[offs[s]:offs[s + 1]]
+            cols = []
+            for ci, c in enumerate(t._columns):
+                v = None if valids[ci] is None \
+                    else jnp.asarray(valids[ci][seg])
+                if c.is_varbytes:
+                    vb = VarBytes.from_host(host[ci][seg])
+                    cols.append(Column(vb.lengths, c.dtype, v, None,
+                                       c.name, varbytes=vb))
+                else:
+                    cols.append(Column(jnp.asarray(host[ci][seg]),
+                                       c.dtype, v, c.dictionary, c.name))
+            shard_tables.append(Table(cols, ctx))
+        out = assemble_process_local(shard_tables, ctx)
+        out._hash_partitioned = partition_signature(key_cols, idxs, world)
+        return out
 
     cols = []
     for ci, c in enumerate(t._columns):
