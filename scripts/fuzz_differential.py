@@ -49,9 +49,11 @@ def canon(df):
     df.columns = range(len(df.columns))
     rows = []
     for t in df.itertuples(index=False):
-        rows.append(tuple("<null>" if v is None or v != v else
-                          (round(float(v), 3) if isinstance(v, float)
-                           else str(v)) for v in t))
+        # stringify EVERY cell so mixed null/str/float columns sort
+        rows.append(tuple(
+            "<null>" if v is None or v != v else
+            (f"{float(v):.3f}" if isinstance(v, (float, np.floating))
+             else str(v)) for v in t))
     return sorted(rows)
 
 
@@ -62,6 +64,7 @@ def one_case(seed):
     n2 = int(rng.integers(8, 400))
     jt = rng.choice(["inner", "left", "right", "outer"])
     force_vb = bool(rng.integers(0, 2)) and "str" in kind
+    with_nulls = bool(rng.integers(0, 2)) and "str" in kind
 
     old = _strings.DICT_MAX_VOCAB
     if force_vb:
@@ -69,6 +72,9 @@ def one_case(seed):
     try:
         ld = rand_table(rng, n1, kind, "v")
         rd = rand_table(rng, n2, kind, "w")
+        if with_nulls:
+            ld["k"][rng.integers(0, n1, max(n1 // 10, 1))] = None
+            rd["k"][rng.integers(0, n2, max(n2 // 10, 1))] = None
         dctx = ct.CylonContext.InitDistributed(ct.TPUConfig())
         lctx = ct.CylonContext.Init()
 
@@ -79,24 +85,35 @@ def one_case(seed):
 
         jd = lt_d.distributed_join(rt_d, jt, on="k").to_pandas()
         jl = lt_l.join(rt_l, jt, on="k").to_pandas()
-        how = {"inner": "inner", "left": "left", "right": "right",
-               "outer": "outer"}[jt]
-        jp = pd.DataFrame(ld).merge(pd.DataFrame(rd), on="k", how=how)
-        # align pandas's merged key into both key slots for comparison
-        jp = pd.DataFrame({0: jp["k"], 1: jp["v"], 2: jp["k"],
-                           3: jp["w"]})
-        if jt in ("left", "right", "outer"):
-            # unmatched side's key is null in our output, not in pandas'
-            jp[2] = jp[2].where(jp[3].notna(), None)
-            jp[0] = jp[0].where(jp[1].notna(), None)
         assert canon(jd) == canon(jl), f"dist!=local join seed={seed}"
-        assert len(jd) == len(jp), \
-            f"rowcount vs pandas seed={seed}: {len(jd)} != {len(jp)}"
+        if not with_nulls:
+            # null-key match semantics differ from pandas (pandas merges
+            # NaN keys as equal) — pandas row counts only on clean keys
+            how = {"inner": "inner", "left": "left", "right": "right",
+                   "outer": "outer"}[jt]
+            jp = pd.DataFrame(ld).merge(pd.DataFrame(rd), on="k",
+                                        how=how)
+            assert len(jd) == len(jp), \
+                f"rowcount vs pandas seed={seed}: {len(jd)} != {len(jp)}"
+
+        # set ops: distributed vs local (schemas must match: k only)
+        sld = ct.Table.from_pydict(dctx, {"k": ld["k"]})
+        srd = ct.Table.from_pydict(dctx, {"k": rd["k"]})
+        sll = ct.Table.from_pydict(lctx, {"k": ld["k"]})
+        srl = ct.Table.from_pydict(lctx, {"k": rd["k"]})
+        for op in ("union", "intersect", "subtract"):
+            ud = getattr(sld, f"distributed_{op}")(srd).to_pandas()
+            ul = getattr(sll, op)(srl).to_pandas()
+            assert canon(ud) == canon(ul), \
+                f"dist!=local {op} seed={seed}"
 
         # groupby sum/count on the left table
         gd = lt_d.groupby(0, [1, 1], ["sum", "count"]).to_pandas()
         gl = lt_l.groupby(0, [1, 1], ["sum", "count"]).to_pandas()
-        gp = pd.DataFrame(ld).groupby("k")["v"].agg(["sum", "count"])
+        # dropna=False: null keys form ONE group here (Arrow/SQL GROUP
+        # BY semantics), which pandas only matches with dropna=False
+        gp = pd.DataFrame(ld).groupby("k", dropna=False)["v"].agg(
+            ["sum", "count"])
         assert len(gd) == len(gl) == len(gp), f"groupby len seed={seed}"
         a = gd.sort_values(gd.columns[0]).reset_index(drop=True)
         b = gl.sort_values(gl.columns[0]).reset_index(drop=True)
