@@ -424,6 +424,62 @@ def bench_dist_string_join(ctx, n_rows: int, iters: int) -> dict:
             "out_rows": out["t"].row_count}
 
 
+def bench_plan_pipeline(ctx, n_rows: int, iters: int) -> dict:
+    """Eager vs PLANNED execution of the canonical analytics pipeline
+    join(on=k) → groupby(on=k): the eager composition pays one exchange
+    per operator; the lazy plan's optimizer propagates partitioning
+    metadata, aggregates the join output in place, and prunes unused
+    payload columns before the exchange. Shuffle counts come from
+    telemetry phase spans (every `shuffle.exchange*` program on the
+    clock), so the elision is recorded, not inferred."""
+    import cylon_tpu as ct
+    from cylon_tpu import plan, telemetry
+    from cylon_tpu.parallel import dist_ops
+
+    rng = np.random.default_rng(9)
+    left = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n_rows // 4, n_rows).astype(np.int32),
+        "v": rng.normal(size=n_rows).astype(np.float32),
+        "z": rng.integers(0, 50, n_rows).astype(np.int32),
+    })
+    right = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n_rows // 4, n_rows).astype(np.int32),
+        "w": rng.normal(size=n_rows).astype(np.float32),
+    })
+    agg = ct.AggregationOp.SUM
+
+    def eager():
+        j = dist_ops.distributed_join(
+            left, right, ct.JoinConfig.InnerJoin([0], [0]))
+        g = dist_ops.distributed_groupby(j, [0], [4], [agg])
+        _sync(g)
+
+    pipe = plan.scan(left).join(plan.scan(right), on="k") \
+        .groupby("lt-0", ["rt-4"], ["sum"])
+
+    def planned():
+        _sync(pipe.execute())
+
+    with telemetry.collect_phases() as ce:
+        eager_s = _time(eager, iters)
+        eager_shuffles = ce.count("shuffle.exchange") // (iters + 1)
+    with telemetry.collect_phases() as cp:
+        plan_s = _time(planned, iters)
+        plan_shuffles = cp.count("shuffle.exchange") // (iters + 1)
+    world = max(ctx.get_world_size(), 1)
+    total = 2 * n_rows
+    return {
+        "world": world,
+        "eager_wall_s_best": round(eager_s, 4),
+        "plan_wall_s_best": round(plan_s, 4),
+        "eager_shuffles": int(eager_shuffles),
+        "plan_shuffles": int(plan_shuffles),
+        "speedup": round(eager_s / plan_s, 3) if plan_s else 0.0,
+        "eager_rows_per_s_per_chip": total / eager_s / world,
+        "plan_rows_per_s_per_chip": total / plan_s / world,
+    }
+
+
 def bench_pandas_reference(n_rows: int, iters: int = 1) -> dict:
     """Same workload, same host, pandas (the reference's Dask-comparison
     discipline, cpp/src/experiments/dask_run.py — a competitor number
@@ -467,6 +523,8 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
              lambda: bench_dist_union(ctx, n_rows // 2, iters)),
             ("q5_pipeline",
              lambda: bench_q5_pipeline(ctx, n_rows // 2, iters)),
+            ("plan_pipeline",
+             lambda: bench_plan_pipeline(ctx, n_rows // 2, iters)),
             ("string_join",
              lambda: bench_string_join(ctx, n_rows // 4, iters)),
             ("dist_string_join",

@@ -27,6 +27,8 @@ from .parallel.dist_ops import (distributed_groupby, distributed_join,
                                 distributed_sort, hash_partition,
                                 repartition, shuffle)
 from .parallel.shard import distribute_by_key
+from . import plan
+from .plan import LazyTable, col
 from .status import Code, CylonError, Status
 
 __version__ = "0.1.0"
@@ -35,7 +37,8 @@ __all__ = [
     "AggregationOp", "Code", "Column", "CommConfig", "CommType",
     "CSVReadOptions", "CSVWriteOptions", "CylonContext", "CylonError",
     "DataType", "JoinAlgorithm", "JoinConfig", "JoinType", "Layout",
-    "LocalConfig", "MPIConfig", "MultiHostConfig", "ParquetOptions", "Row",
+    "LazyTable", "LocalConfig", "MPIConfig", "MultiHostConfig",
+    "ParquetOptions", "Row", "col", "plan",
     "Status", "TPUConfig", "Table", "Type", "concat_tables",
     "distribute_by_key", "distributed_groupby", "distributed_join",
     "distributed_join_ring", "distributed_set_op",

@@ -300,9 +300,17 @@ class Table:
         return Table(cols, self._ctx)
 
     def project(self, columns: Sequence[Union[int, str]]) -> "Table":
-        """Zero-copy column subset (reference: Project, table.cpp:1066-1085)."""
+        """Zero-copy column subset (reference: Project, table.cpp:1066-1085).
+        The hash-placement witness survives (positions remapped) when
+        every witnessed key column is kept — projection never moves
+        rows, so a later same-key shuffle can still skip."""
         idxs = [self._col_index(c) for c in columns]
-        return Table([self._columns[i] for i in idxs], self._ctx, self.row_mask)
+        t = Table([self._columns[i] for i in idxs], self._ctx, self.row_mask)
+        hp = self._hash_partitioned
+        if hp is not None and all(k in idxs for k in hp[0]):
+            t._hash_partitioned = (tuple(idxs.index(k) for k in hp[0]),
+                                   ) + tuple(hp[1:])
+        return t
 
     def select(self, predicate) -> "Table":
         """Row-lambda filter (reference: Select, table.cpp:698-727 — a host
@@ -765,6 +773,28 @@ def _expanded_keys(cols: Sequence[Column], paired: Sequence[Column] = None):
     return tuple(keys), tuple(valids), tuple(flags)
 
 
+def _memo_refs(cols: Sequence[Column]) -> Tuple[Tuple, Tuple]:
+    """(id-key, liveness refs) over EVERY buffer a count result depends
+    on: data, validity, and varbytes words/starts (ADVICE r5 low —
+    keying on id(data) alone would return stale counts for a column
+    sharing a data buffer with different validity or string content,
+    and weakref-anchoring only data would let a recycled id alias a
+    dead entry). Shared by the join count memos here and the splitter
+    memo in parallel/dist_ops."""
+    ids, refs = [], []
+    for c in cols:
+        bufs = [c.data]
+        if c.validity is not None:
+            bufs.append(c.validity)
+        if c.is_varbytes:
+            bufs.append(c.varbytes.words)
+            bufs.append(c.varbytes.starts)
+        for b in bufs:
+            ids.append(id(b))
+            refs.append(b)
+    return tuple(ids), tuple(refs)
+
+
 def join(left: Table, right: Table, config: _join.JoinConfig) -> Table:
     """Local join (reference: cylon::Join, table.cpp:640-654). Exactly TWO
     compiled programs (count, then materialize) — only the 4 output-count
@@ -895,14 +925,13 @@ def _join_once(left: Table, right: Table, config: _join.JoinConfig) -> Table:
             # identity — jax arrays are immutable): repeat joins of the
             # same tables skip this ~100 ms host sync; the device
             # `counts` still feeds materialize either way
+            lids, lrefs = _memo_refs(lcols)
+            rids, rrefs = _memo_refs(rcols)
             ck = ("join_counts", int(config.type), bool(hash_mode),
                   tuple(config.left_column_idx),
                   tuple(config.right_column_idx),
-                  tuple(id(c.data) for c in lcols),
-                  tuple(id(c.data) for c in rcols),
-                  id(lemit), id(remit))
-            refs = tuple(c.data for c in lcols) \
-                + tuple(c.data for c in rcols) \
+                  lids, rids, id(lemit), id(remit))
+            refs = lrefs + rrefs \
                 + tuple(x for x in (lemit, remit) if x is not None)
             host_counts = _count_cached(
                 ck, refs, lambda: jax.device_get(counts))
@@ -937,14 +966,13 @@ def _join_once(left: Table, right: Table, config: _join.JoinConfig) -> Table:
                 config.type)
             # same memoization as the stream path: repeat joins of the
             # same tables skip the count host sync
+            lids, lrefs = _memo_refs(lcols)
+            rids, rrefs = _memo_refs(rcols)
             ck = ("join_counts_xla", int(config.type),
                   tuple(config.left_column_idx),
                   tuple(config.right_column_idx),
-                  tuple(id(c.data) for c in lcols),
-                  tuple(id(c.data) for c in rcols),
-                  id(lemit), id(remit))
-            refs = tuple(c.data for c in lcols) \
-                + tuple(c.data for c in rcols) \
+                  lids, rids, id(lemit), id(remit))
+            refs = lrefs + rrefs \
                 + tuple(x for x in (lemit, remit) if x is not None)
             n_primary, n_un = (int(v) for v in _count_cached(
                 ck, refs, lambda: jax.device_get(counts2)))

@@ -179,16 +179,113 @@ def _fmix64_np(h: np.ndarray) -> np.ndarray:
     return h ^ (h >> np.uint64(33))
 
 
+# content-hash scheme 1 constants — MUST match data/strings.py
+# (_G1, _S1) so host varbytes hashes equal the device h1 exactly
+_VB_G1 = np.uint32(31)
+_VB_S1 = np.uint32(0x2545F491)
+
+
+def np_varbytes_hash(values: Sequence) -> np.ndarray:
+    """Per-row uint32 content hash of host str/bytes values — the exact
+    numpy mirror of the DEVICE varbytes identity h1 (data/strings.py
+    _hash_rows, scheme 1), so host-side partition placement of string
+    keys is a pure function of the key BYTES: equal keys hash equal in
+    any table, any vocabulary, host or device (ADVICE r5 medium — the
+    old np.unique-code hashing made placement depend on the table-local
+    key set). None/NaN rows hash as empty; callers overlay the null tag
+    via the validity mask, same as the device path."""
+    enc: List[bytes] = []
+    for v in values:
+        if v is None or (isinstance(v, float) and v != v):
+            enc.append(b"")
+        elif isinstance(v, bytes):
+            enc.append(v)
+        else:
+            enc.append(str(v).encode("utf-8"))
+    n = len(enc)
+    if n == 0:
+        return np.zeros(0, np.uint32)
+    lengths = np.fromiter((len(b) for b in enc), np.int64, n)
+    nw = (lengths + 3) // 4
+    starts = np.concatenate([[0], np.cumsum(nw)])
+    total = int(starts[-1])
+    # word-aligned packed buffer (zero tail padding — the storage
+    # invariant the device hash relies on)
+    buf = np.zeros(max(total, 1) * 4, np.uint8)
+    if total:
+        src = np.frombuffer(b"".join(enc), np.uint8)
+        src_starts = np.concatenate([[0], np.cumsum(lengths)])[:-1]
+        rows_rep = np.repeat(np.arange(n), lengths)
+        p = np.arange(len(rows_rep)) - np.repeat(src_starts, lengths)
+        buf[np.repeat(starts[:-1] * 4, lengths) + p] = src
+    words = buf.view("<u4")
+    # mix(w, seed) — strings._mix
+    h = words ^ _VB_S1
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    # g^p per word (p = in-row word offset), then one cumsum + range
+    # difference per row — same prefix-sum trick as the device kernel
+    word_p = np.arange(total, dtype=np.int64) - np.repeat(starts[:-1], nw)
+    gp = np.ones(total, np.uint32)
+    acc = np.full(1, _VB_G1)
+    e = word_p.astype(np.uint64)
+    with np.errstate(over="ignore"):  # uint32 wrap IS the hash arithmetic
+        for b in range(max(int(nw.max()).bit_length(), 1)):
+            gp = np.where((e >> np.uint64(b)) & np.uint64(1) == 1,
+                          gp * acc, gp)
+            acc = acc * acc
+    P = np.cumsum(h[:total] * gp, dtype=np.uint32) if total else \
+        np.zeros(0, np.uint32)
+    end = np.clip(starts[1:] - 1, 0, max(total - 1, 0))
+    prev = np.clip(starts[:-1] - 1, 0, max(total - 1, 0))
+    hi = P[end] if total else np.zeros(n, np.uint32)
+    lo = np.where(starts[:-1] > 0, P[prev] if total else np.uint32(0),
+                  np.uint32(0))
+    out = np.where(nw > 0, hi - lo, np.uint32(0)).astype(np.uint32)
+    out = out ^ (lengths.astype(np.uint32) * np.uint32(0x9E3779B1)) ^ _VB_S1
+    out = out ^ (out >> np.uint32(16))
+    out = out * np.uint32(0x7FEB352D)
+    out = out ^ (out >> np.uint32(15))
+    out = out * np.uint32(0x846CA68B)
+    return out ^ (out >> np.uint32(16))
+
+
 def row_hash(cols: Sequence[np.ndarray],
              valids: Sequence[Optional[np.ndarray]],
-             is_string: Optional[Sequence[bool]] = None) -> np.ndarray:
+             is_string: Optional[Sequence[bool]] = None,
+             prehashed: Optional[Sequence[bool]] = None) -> np.ndarray:
     """Combined per-row uint32 hash of host columns — same value the
     device computes in ops/hash.hash_columns. `cols` are raw value arrays
     (ordered-bit normalization happens here); string columns pass their
     dictionary CODES with is_string=True (codes widen to u32 unsigned,
-    matching ops/order.ordered_bits_raw's string path)."""
+    matching ops/order.ordered_bits_raw's string path). Columns flagged
+    in ``prehashed`` carry already-finalized uint32 row hashes (the
+    varbytes content-hash path, np_varbytes_hash) that enter the combine
+    directly — only the null tag is overlaid."""
     n = len(cols[0])
     flags = is_string or [False] * len(cols)
+    pre = prehashed or [False] * len(cols)
+    if any(pre):
+        # numpy combine (the native kernel hashes raw bits itself and
+        # cannot accept finalized hashes)
+        h = np.zeros(n, np.uint32)
+        for c, s, v, p in zip(cols, flags, valids, pre):
+            if p:
+                hc = np.ascontiguousarray(np.asarray(c, dtype=np.uint32))
+            else:
+                bits = np.asarray(c).astype(np.uint32) if s \
+                    else np_ordered_bits(c)
+                b, w = _norm_width(bits)
+                if w == 8:
+                    m = _fmix64_np(b)
+                    hc = (m ^ (m >> np.uint64(32))).astype(np.uint32)
+                else:
+                    hc = _fmix32_np(b)
+            if v is not None:
+                hc = np.where(np.asarray(v, dtype=bool), hc, _NULL_TAG)
+            h = h * np.uint32(31) + hc
+        return _fmix32_np(h)
     bit_cols: List[np.ndarray] = []
     widths: List[int] = []
     for c, s in zip(cols, flags):
@@ -228,15 +325,17 @@ def row_hash(cols: Sequence[np.ndarray],
 
 def hash_partition(cols: Sequence[np.ndarray],
                    valids: Sequence[Optional[np.ndarray]],
-                   world: int, is_string: Optional[Sequence[bool]] = None
+                   world: int, is_string: Optional[Sequence[bool]] = None,
+                   prehashed: Optional[Sequence[bool]] = None
                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Host-side hash partition: (targets i32[n], counts i64[world],
     order i64[n]) where `order` is the stable row permutation grouping
     rows by target — gathering rows by `order` and splitting at cumsum
     (counts) yields the per-target row sets. Placement is bit-identical
     to the device's ops/hash.partition_targets, so host-ingest placement
-    and device shuffle placement agree."""
-    h = row_hash(cols, valids, is_string)
+    and device shuffle placement agree. ``prehashed`` marks columns that
+    already carry finalized uint32 row hashes (varbytes content keys)."""
+    h = row_hash(cols, valids, is_string, prehashed)
     n = len(h)
     lib = _load()
     if lib is not None and n > 0:

@@ -41,6 +41,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):  # pre-0.5 jax naming
+    def _compiler_params_compat(has_side_effects: bool = False):
+        # TPUCompilerParams grew has_side_effects later; the dict form
+        # ({"mosaic": {...}}) is the spelling old pallas_call accepts
+        return {"mosaic": {"has_side_effects": bool(has_side_effects)}}
+
+    pltpu.CompilerParams = _compiler_params_compat
+
 LANES = 128
 _I32MAX = jnp.iinfo(jnp.int32).max
 
@@ -51,7 +59,11 @@ def _x32_trace():
     Mosaic's int64 convert_element_type rule recurses forever; every
     kernel here is 32-bit by construction, so the promotion is never
     wanted."""
-    return jax.enable_x64(False)
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(False)
+    from jax.experimental import enable_x64 as _e64  # pre-0.5 jax home
+
+    return _e64(False)
 
 
 def _roll(x, k, axis, interpret=False):

@@ -1055,6 +1055,21 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
             # the encoded tables share payload columns with the inputs
             return _exact_dict_redo(left, right, config, exact_pairs,
                                     force_exchange)
+    # co-partitioning witness on the OUTPUT: every emitted row sits on
+    # the shard its join-key hash routed it to, so a later shuffle /
+    # pre-partitioned groupby on the same keys can skip its exchange
+    # (the plan optimizer's shuffle-elision hook). Key positions map
+    # straight through (left columns first); dtypes come from the
+    # ALIGNED columns — if alignment promoted, the signature's dtype
+    # string won't match the output column's and the witness correctly
+    # never fires. Outer sides with unmatched null keys invalidate the
+    # witness for that side.
+    if jt in (_join.JoinType.INNER, _join.JoinType.LEFT):
+        result._hash_partitioned = shard.partition_signature(
+            lcols2, tuple(lidx), world)
+    elif jt == _join.JoinType.RIGHT:
+        result._hash_partitioned = shard.partition_signature(
+            rcols2, tuple(nl + j for j in ridx), world)
     left._free_if_unretained()
     right._free_if_unretained()
     return result
@@ -1089,9 +1104,16 @@ def _exact_dict_redo(left: Table, right: Table, config: _join.JoinConfig,
     re-encode each colliding key pair over ONE shared sorted vocabulary
     (host round trip — paid only when a collision was actually detected,
     i.e. ~never) and redo the distributed join on the exact int32
-    codes (same mechanism as the local `_exact_dict_fallback_join`)."""
+    codes (same mechanism as the local `_exact_dict_fallback_join`).
+    The redo's dictionary-coded key columns are re-materialized as
+    varbytes so the recovery path's output schema matches the normal
+    path, and the unretained originals are freed once the redo no
+    longer shares their buffers (ADVICE r5 low — this path used to
+    leak retain=False inputs and leak the storage change)."""
     from ..data.table import _dict_encode_pair
 
+    ctx = left._ctx
+    nl = left.column_count
     lcols2, rcols2 = list(left._columns), list(right._columns)
     for li, rj in pairs:
         lcols2[li], rcols2[rj] = _dict_encode_pair(left._columns[li],
@@ -1099,9 +1121,28 @@ def _exact_dict_redo(left: Table, right: Table, config: _join.JoinConfig,
     cfg = _join.JoinConfig(config.type, config.left_column_idx,
                            config.right_column_idx, config.algorithm,
                            exact=False)
-    return distributed_join(Table(lcols2, left._ctx, left.row_mask),
-                            Table(rcols2, right._ctx, right.row_mask),
-                            cfg, force_exchange=force_exchange)
+    res = distributed_join(Table(lcols2, left._ctx, left.row_mask),
+                           Table(rcols2, right._ctx, right.row_mask),
+                           cfg, force_exchange=force_exchange)
+    # decode the redone key columns back through the shared vocab so the
+    # output carries varbytes storage exactly like the collision-free path
+    from ..data.column import as_varbytes
+
+    out_cols = list(res._columns)
+    for li, rj in pairs:
+        for pos in (li, nl + rj):
+            c = out_cols[pos]
+            if c.dictionary is not None:
+                vb_col = _dist_as_varbytes(ctx, c) \
+                    if ctx.is_distributed() and ctx.get_world_size() > 1 \
+                    else as_varbytes(c)
+                out_cols[pos] = vb_col.rename(c.name)
+    res = Table(out_cols, res._ctx, res.row_mask)
+    # the redo is fully materialized now — nothing shares the originals'
+    # buffers except via XLA refcounts, so the deferred frees are safe
+    left._free_if_unretained()
+    right._free_if_unretained()
+    return res
 
 
 # ---------------------------------------------------------------------------
@@ -1126,7 +1167,9 @@ def _varying(axis, tree):
     if pc is not None:
         return jax.tree.map(lambda x: jax.lax.pcast(x, axis, to="varying"),
                             tree)
-    return jax.tree.map(lambda x: jax.lax.pvary(x, (axis,)), tree)  # pragma: no cover
+    if hasattr(jax.lax, "pvary"):  # pragma: no cover
+        return jax.tree.map(lambda x: jax.lax.pvary(x, (axis,)), tree)
+    return tree  # old jax: no varying-mesh-axes checker to satisfy
 
 
 @lru_cache(maxsize=None)
@@ -1509,17 +1552,27 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp,
 
 def _groupby_shuffle_agg(ctx: CylonContext, key_columns, value_columns,
                          ops: Tuple, emit, seq, col_ids: Tuple = None,
-                         dense: bool = False):
+                         dense: bool = False, skip_exchange: bool = False):
     """Shuffle rows by key hash, then aggregate per shard. Returns
     (key_out_cols, agg list of (arr, valid), gvalid). ``col_ids``: static
     source-column names for the aggregate's sub-reduction dedup (repeated
-    (column, op) pairs compute once — see sorted_segment_aggregate)."""
-    with _phase("distributed_groupby.shuffle", seq):
-        view = Table(list(key_columns) + list(value_columns), ctx, None)
-        targets = shard.pin(
-            _partition_targets_dist(ctx, key_columns), ctx)
-        out_cols, emit_s, _x = _exchange_table(view, targets, emit, ctx,
-                                               dense=dense)
+    (column, op) pairs compute once — see sorted_segment_aggregate).
+    ``skip_exchange``: caller asserts every key's rows are already
+    co-located on one shard (a co-partitioning witness from a prior
+    shuffle/join on the same keys) — the per-shard aggregation is then
+    globally exact with NO exchange at all (the plan optimizer's elided
+    groupby-after-join path)."""
+    if skip_exchange:
+        out_cols = list(key_columns) + list(value_columns)
+        emit_s = emit
+    else:
+        with _phase("distributed_groupby.shuffle", seq):
+            view = Table(list(key_columns) + list(value_columns), ctx,
+                         None)
+            targets = shard.pin(
+                _partition_targets_dist(ctx, key_columns), ctx)
+            out_cols, emit_s, _x = _exchange_table(view, targets, emit,
+                                                   ctx, dense=dense)
 
     nk = len(key_columns)
     kcols_s = out_cols[:nk]
@@ -1558,7 +1611,14 @@ def _groupby_shuffle_agg(ctx: CylonContext, key_columns, value_columns,
 
 def distributed_groupby(table: Table, index_col, aggregate_cols: List,
                         aggregate_ops: List[_groupby.AggregationOp],
-                        pre_aggregate: bool = True) -> Table:
+                        pre_aggregate: bool = True,
+                        pre_partitioned: bool = False) -> Table:
+    """``pre_partitioned``: caller asserts the table's rows are already
+    hash-placed by the groupby keys (e.g. the output of a join/shuffle
+    on the same keys, witnessed by ``_hash_partitioned``) — the whole
+    exchange is skipped and ONE per-shard aggregation pass produces the
+    exact global result. The plan executor verifies the witness before
+    setting this; a false assertion would split groups across shards."""
     ctx = table._ctx
     world = ctx.get_world_size()
     if world == 1:
@@ -1583,11 +1643,12 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
     SUM = _groupby.AggregationOp.SUM
     COUNT = _groupby.AggregationOp.COUNT
 
-    if not pre_aggregate:
+    if pre_partitioned or not pre_aggregate:
         value_columns = [t._columns[vi] for vi in val_cols]
         key_out, agg, gvalid = _groupby_shuffle_agg(
             ctx, key_columns, value_columns, tuple(ops), emit, seq,
-            col_ids=tuple(val_cols), dense=t.row_mask is None)
+            col_ids=tuple(val_cols), dense=t.row_mask is None,
+            skip_exchange=pre_partitioned)
         cols = list(key_out)
         for (arr, av), vi, op in zip(agg, val_cols, ops):
             src = t._columns[vi]
@@ -1597,7 +1658,12 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
             cols.append(Column(arr, table_mod._agg_dtype(src, op), av,
                                src.dictionary if keep_dict else None,
                                src.name))
-        return Table(cols, ctx, gvalid)
+        out = Table(cols, ctx, gvalid)
+        # output keys stay hash-placed (rows never moved / moved by key
+        # hash): witness lets a further same-key stage skip its shuffle
+        out._hash_partitioned = shard.partition_signature(
+            key_out, tuple(range(len(key_out))), world)
+        return out
 
     # ---- phase A: per-shard partial aggregation (shuffle bytes then
     # scale with per-shard GROUPS, not rows). MEAN expands to
@@ -1680,7 +1746,12 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
             cols.append(Column(arr, table_mod._agg_dtype(src, op), av,
                                src.dictionary if keep_dict else None,
                                src.name))
-    return Table(cols, ctx, gvalid)
+    out = Table(cols, ctx, gvalid)
+    # phase B placed every group on its key-hash shard: witness the
+    # partitioning so later same-key stages can elide their shuffles
+    out._hash_partitioned = shard.partition_signature(
+        key_out, tuple(range(len(key_out))), world)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1839,11 +1910,15 @@ def distributed_sort(table: Table, order_by, ascending=True,
         # derived arrays every call, so the key is the source data
         from .shuffle import _count_cached
 
-        src_refs = tuple(c.data for c in order_cols) + \
-            ((t.row_mask,) if t.row_mask is not None else ())
+        # memo key/refs span data + validity + varbytes buffers (ADVICE
+        # r5 low — data ids alone could alias columns differing only in
+        # validity or string content), same discipline as the join memos
+        src_ids, src_refs = table_mod._memo_refs(order_cols)
+        if t.row_mask is not None:
+            src_ids = src_ids + (id(t.row_mask),)
+            src_refs = src_refs + (t.row_mask,)
         splitters = _count_cached(
-            ("splitters", id(ctx.mesh), tuple(asc), world)
-            + tuple(id(r) for r in src_refs),
+            ("splitters", id(ctx.mesh), tuple(asc), world) + src_ids,
             src_refs, lambda: _range_splitters(ctx, lanes, emit))
         targets = _splitter_targets(lanes, splitters)
         cols_s, emit_s, _x = _exchange_table(

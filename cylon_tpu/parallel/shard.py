@@ -189,10 +189,13 @@ def host_partition_arrays(t: Table, idxs, world: int):
     lives in exactly one place.
 
     Varbytes columns come to host as object arrays; varbytes KEY columns
-    dictionary-encode on the fly (np.unique codes, sorted vocab) so the
-    native partitioner hashes ints — the round-5 fix for the long-string
-    hash_partition fallback, which previously rejected varbytes
-    outright."""
+    hash their actual BYTES through the host mirror of the device
+    content hash (native.np_varbytes_hash == strings._hash_rows h1), so
+    placement is a pure function of key VALUES — equal keys in two
+    independently built tables land on the same partition, and the host
+    fallback agrees with the device hash_partition path. (ADVICE r5
+    medium: the previous table-local np.unique dictionary codes made
+    placement depend on each table's whole key set.)"""
     from .. import native as _native
     from ..dtypes import Type
 
@@ -207,18 +210,19 @@ def host_partition_arrays(t: Table, idxs, world: int):
               else np.asarray(jax.device_get(c.valid_mask()))
               for c in t._columns]
     keys = []
+    pre = []
     for i in idxs:
         if t._columns[i].is_varbytes:
-            filler = b"" if t._columns[i].dtype.type == Type.BINARY else ""
-            safe = np.array([filler if v is None else v for v in host[i]],
-                            dtype=object)
-            _vocab, codes = np.unique(safe, return_inverse=True)
-            keys.append(codes.astype(np.int32))
+            keys.append(_native.np_varbytes_hash(host[i]))
+            pre.append(True)
         else:
             keys.append(host[i])
-    flags = [t._columns[i].is_string for i in idxs]
+            pre.append(False)
+    flags = [False if p else t._columns[i].is_string
+             for i, p in zip(idxs, pre)]
     _targets, counts, order = _native.hash_partition(
-        keys, [valids[i] for i in idxs], world, is_string=flags)
+        keys, [valids[i] for i in idxs], world, is_string=flags,
+        prehashed=pre)
     offs = np.concatenate([[0], np.cumsum(counts)])
     return host, valids, counts, order, offs
 

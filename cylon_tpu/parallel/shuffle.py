@@ -117,7 +117,9 @@ def _to_varying_fn(axis):
     _vary = getattr(jax.lax, "pcast", None)
     if _vary is not None:
         return lambda x: jax.lax.pcast(x, axis, to="varying")
-    return lambda x: jax.lax.pvary(x, (axis,))  # pragma: no cover
+    if hasattr(jax.lax, "pvary"):  # pragma: no cover
+        return lambda x: jax.lax.pvary(x, (axis,))
+    return lambda x: x  # old jax: no varying-mesh-axes checker to satisfy
 
 
 def _bucket_sort(payload, targets, emit, world):

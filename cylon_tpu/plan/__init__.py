@@ -1,0 +1,36 @@
+"""Lazy query-plan subsystem: logical IR, optimizer, executor.
+
+The reference shipped its task-graph layer as an unfinished overlay
+(`LogicalTaskPlan` + `ArrowTaskAllToAll`, arrow_task_all_to_all.h:9-57);
+here the layer is completed the way the paper's own cost model demands:
+every distributed op is *local kernel + all-to-all + local kernel*
+(PAPER.md §1, docs/arch.md), so the dominant optimization is running
+FEWER all-to-alls. A `LazyTable` builds a logical plan (`Scan`,
+`Project`, `Filter`, `Join`, `GroupBy`, `SetOp`, `Sort`, `Shuffle`
+nodes) over the `table_api` registry; the optimizer propagates
+partitioning metadata and (1) deletes `Shuffle` nodes whose input is
+already hash-placed on the same keys, (2) prunes unreferenced columns
+below the exchanges, and (3) pushes filters below shuffles so dead rows
+drop in transit; the executor lowers the optimized plan onto the
+existing `dist_ops`/`table_api` primitives (never `ops/` kernels — see
+scripts/check_plan_imports.py) and stamps per-node `telemetry.phase`
+spans, so a plan's shuffle count is directly observable in logs and
+Perfetto traces as ``plan.shuffle.*`` labels.
+
+The retired `parallel/task_plan.py` task-routing overlay lives on as
+`plan.tasks` (same `LogicalTaskPlan`/`task_exchange` API).
+"""
+from . import ir, optimizer, executor, tasks
+from .ir import (Filter, GroupBy, Join, PlanNode, Project, Scan, SetOp,
+                 Shuffle, Sort, col)
+from .lazy import LazyTable, scan
+from .optimizer import PlanStats, optimize
+from .executor import execute
+from .tasks import LogicalTaskPlan, task_exchange
+
+__all__ = [
+    "Filter", "GroupBy", "Join", "LazyTable", "LogicalTaskPlan",
+    "PlanNode", "PlanStats", "Project", "Scan", "SetOp", "Shuffle",
+    "Sort", "col", "execute", "executor", "ir", "optimize", "optimizer",
+    "scan", "task_exchange", "tasks",
+]

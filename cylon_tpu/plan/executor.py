@@ -1,0 +1,154 @@
+"""Plan executor: lowers an (optimized) logical plan onto `dist_ops`.
+
+Lowering discipline (enforced by scripts/check_plan_imports.py): the
+executor reaches device kernels ONLY through `parallel/dist_ops`,
+`data/table` methods, and `table_api` — never `ops/` directly. Every
+node executes inside a `telemetry.phase` span; nodes that perform an
+all-to-all exchange use ``plan.shuffle.<kind>`` labels, so a plan's
+real shuffle count is countable from the host log or a Perfetto trace
+(grep ``plan.shuffle``).
+
+Shuffle markers below a `Join` are NOT executed standalone: they fold
+into `distributed_join`, whose fused two-table exchange runs both
+sides in one compiled program (one count sync instead of two). A side
+whose marker was elided arrives co-partitioned and `distributed_join`
+skips it via the runtime witness.
+
+`GroupBy.local_ok` (set by the optimizer) is re-verified against the
+RUNTIME witness before the exchange is skipped — plan metadata alone
+is never trusted for a correctness-bearing skip; on mismatch the
+lowering falls back to the exchanging path (and honestly logs it as a
+shuffle).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import table_api
+from ..data import table as table_mod
+from ..data.table import Table
+from ..status import Code, CylonError
+from ..telemetry import phase as _phase
+from . import ir
+
+
+def _world(ctx) -> int:
+    return ctx.get_world_size() if ctx.is_distributed() else 1
+
+
+def execute(plan: ir.PlanNode, ctx=None) -> Table:
+    """Execute a plan; returns the result Table (sharded when the
+    context is distributed). ``ctx`` defaults to the first scanned
+    table's context."""
+    return _Exec(ctx).run(plan)
+
+
+class _Exec:
+    def __init__(self, ctx=None):
+        self.ctx = ctx
+
+    def run(self, node: ir.PlanNode) -> Table:
+        fn = getattr(self, f"_do_{node.kind}", None)
+        if fn is None:
+            raise CylonError(Code.NotImplemented,
+                             f"no lowering for {type(node).__name__}")
+        return fn(node)
+
+    def _seq(self) -> Optional[int]:
+        return self.ctx.get_next_sequence() if self.ctx is not None else None
+
+    # -- leaves ---------------------------------------------------------
+
+    def _do_scan(self, node: ir.Scan) -> Table:
+        t = node.table if node.table is not None \
+            else table_api.get_table(node.table_id)
+        if self.ctx is None:
+            self.ctx = t._ctx
+        return t
+
+    # -- row/column ops -------------------------------------------------
+
+    def _do_project(self, node: ir.Project) -> Table:
+        t = self.run(node.children[0])
+        with _phase("plan.project", self._seq()):
+            return t.project(node.cols)
+
+    def _do_filter(self, node: ir.Filter) -> Table:
+        t = self.run(node.children[0])
+        with _phase("plan.filter", self._seq()):
+            return t.filter_mask(node.expr.mask(t))
+
+    # -- exchanges ------------------------------------------------------
+
+    def _do_shuffle(self, node: ir.Shuffle) -> Table:
+        from ..parallel import dist_ops, shard
+
+        t = self.run(node.children[0])
+        if _world(self.ctx) == 1:
+            return t
+        # runtime-witness check BEFORE the span: an already-placed input
+        # makes this a no-op, which must not count as an exchange stage
+        sig = shard.partition_signature(
+            [t._columns[k] for k in node.keys], tuple(node.keys),
+            self.ctx.get_world_size())
+        if sig is not None and t._hash_partitioned == sig:
+            return t
+        with _phase("plan.shuffle.explicit", self._seq()):
+            return dist_ops.shuffle(t, node.keys)
+
+    def _do_join(self, node: ir.Join) -> Table:
+        l, r = node.children
+        # fold Shuffle markers into the join's own (fused, skippable)
+        # exchange machinery instead of running them standalone
+        lsrc = l.children[0] if isinstance(l, ir.Shuffle) else l
+        rsrc = r.children[0] if isinstance(r, ir.Shuffle) else r
+        n_ex = int(isinstance(l, ir.Shuffle)) + int(isinstance(r, ir.Shuffle))
+        lt = self.run(lsrc)
+        rt = self.run(rsrc)
+        label = "plan.shuffle.join" if n_ex and _world(self.ctx) > 1 \
+            else "plan.join"
+        with _phase(label, self._seq()):
+            return lt.distributed_join(
+                rt, node.how, node.algorithm,
+                left_on=list(node.left_on), right_on=list(node.right_on))
+
+    def _do_groupby(self, node: ir.GroupBy) -> Table:
+        from ..parallel import dist_ops, shard
+
+        t = self.run(node.children[0])
+        ops = [table_mod._as_agg_op(o) for o in node.ops]
+        if _world(self.ctx) == 1:
+            with _phase("plan.groupby", self._seq()):
+                return table_mod.groupby_local(t, node.keys,
+                                               node.agg_cols, ops)
+        local = False
+        if node.local_ok:
+            # re-verify the plan's claim against the runtime witness —
+            # a false local aggregation would split groups across shards
+            key_cols = [t._columns[k] for k in node.keys]
+            sig = shard.partition_signature(key_cols, tuple(node.keys),
+                                            self.ctx.get_world_size())
+            local = sig is not None and t._hash_partitioned == sig
+        label = "plan.groupby" if local else "plan.shuffle.groupby"
+        with _phase(label, self._seq()):
+            return dist_ops.distributed_groupby(
+                t, node.keys, node.agg_cols, ops, pre_partitioned=local)
+
+    def _do_setop(self, node: ir.SetOp) -> Table:
+        lt = self.run(node.children[0])
+        rt = self.run(node.children[1])
+        if _world(self.ctx) == 1:
+            with _phase("plan.setop", self._seq()):
+                return getattr(lt, node.op)(rt)
+        with _phase("plan.shuffle.setop", self._seq()):
+            return getattr(lt, f"distributed_{node.op}")(rt)
+
+    def _do_sort(self, node: ir.Sort) -> Table:
+        from ..parallel import dist_ops
+
+        t = self.run(node.children[0])
+        if _world(self.ctx) == 1:
+            with _phase("plan.sort", self._seq()):
+                return t.sort(node.by, node.ascending)
+        with _phase("plan.shuffle.sort", self._seq()):
+            return dist_ops.distributed_sort(t, node.by, node.ascending)

@@ -1,0 +1,277 @@
+"""Plan optimizer: physical shuffle insertion + three rewrite passes.
+
+Pass order is load-bearing:
+
+1. ``insert_shuffles`` — physical planning: every join side gets an
+   explicit `Shuffle` on its keys (the paper's local/all-to-all/local
+   composition made visible as IR). GroupBy/SetOp/Sort keep their
+   exchanges internal to `dist_ops` (pre-aggregation and range
+   partitioning beat a naive key shuffle), so no node is inserted for
+   them — the elision pass instead decides whether they may skip.
+2. ``pushdown_filters`` — `Filter(Shuffle(x))` → `Shuffle(Filter(x))`:
+   the shuffle's emit mask drops filtered rows IN TRANSIT, so the
+   filter costs one elementwise AND and the exchange moves fewer rows.
+3. ``prune_projections`` — required-column analysis: columns no
+   downstream node references are dropped at the scans (a `Project`
+   over the `Scan`), so fewer payload leaves cross the mesh. All
+   position references (keys, aggregates, exprs) are remapped.
+4. ``elide_shuffles`` — partitioning-metadata propagation: each node's
+   ``partitioned_by`` is computed bottom-up (scan witnesses seed it); a
+   join-side `Shuffle` whose input already satisfies its keys is
+   DELETED (safe: `distributed_join` re-verifies the runtime witness
+   and a stale claim just re-exchanges), a standalone `Shuffle` is kept
+   and skipped at run time after the executor re-checks the witness,
+   and a `GroupBy` whose input satisfies its keys is marked
+   ``local_ok`` (lowered to a per-shard aggregation with no exchange,
+   again after runtime re-verification). Metadata never propagates
+   through string keys or dtype-promoting joins — exactly the cases
+   where the runtime witness (`shard.partition_signature`) is also
+   None, so plan-time claims and run-time skips cannot diverge.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set, Tuple
+
+from . import ir
+
+
+@dataclass
+class PlanStats:
+    shuffles_inserted: int = 0
+    shuffles_elided: int = 0
+    groupbys_localized: int = 0
+    filters_pushed: int = 0
+    columns_pruned: int = 0
+    notes: list = field(default_factory=list)
+
+    def summary(self) -> str:
+        return (f"shuffles: {self.shuffles_inserted} planned, "
+                f"{self.shuffles_elided} elided; "
+                f"groupbys localized: {self.groupbys_localized}; "
+                f"filters pushed below shuffle: {self.filters_pushed}; "
+                f"columns pruned: {self.columns_pruned}")
+
+
+# ---------------------------------------------------------------------------
+# pass 1: physical shuffle insertion
+# ---------------------------------------------------------------------------
+
+
+def insert_shuffles(node: ir.PlanNode, world: int,
+                    stats: PlanStats) -> ir.PlanNode:
+    children = [insert_shuffles(c, world, stats) for c in node.children]
+    node.children = children
+    if isinstance(node, ir.Join) and world > 1:
+        for side, keys in ((0, node.left_on), (1, node.right_on)):
+            c = node.children[side]
+            # an existing same-key Shuffle (user .shuffle()) already is
+            # the physical exchange; different keys still need ours
+            if not (isinstance(c, ir.Shuffle) and c.keys == list(keys)):
+                node.children[side] = ir.Shuffle(c, keys)
+                stats.shuffles_inserted += 1
+    return node
+
+
+# ---------------------------------------------------------------------------
+# pass 2: filter pushdown below shuffle
+# ---------------------------------------------------------------------------
+
+
+def pushdown_filters(node: ir.PlanNode, stats: PlanStats) -> ir.PlanNode:
+    node.children = [pushdown_filters(c, stats) for c in node.children]
+    if isinstance(node, ir.Filter) and \
+            isinstance(node.children[0], ir.Shuffle):
+        sh = node.children[0]
+        # shuffle is schema-identity, so the expr's positions transfer
+        pushed = ir.Filter(sh.children[0], node.expr)
+        stats.filters_pushed += 1
+        return pushdown_filters(ir.Shuffle(pushed, sh.keys), stats)
+    return node
+
+
+# ---------------------------------------------------------------------------
+# pass 3: projection pruning
+# ---------------------------------------------------------------------------
+
+
+def prune_projections(root: ir.PlanNode, stats: PlanStats) -> ir.PlanNode:
+    all_pos = set(range(root.width))
+    new_root, mapping = _prune(root, all_pos, stats)
+    if new_root.width != root.width or \
+            any(mapping[p] != p for p in all_pos):
+        # restore the exact root schema (order and width)
+        new_root = ir.Project(new_root, [mapping[p] for p in range(root.width)])
+    return new_root
+
+
+def _identity(n: int) -> Dict[int, int]:
+    return {i: i for i in range(n)}
+
+
+def _prune(node: ir.PlanNode, required: Set[int], stats: PlanStats
+           ) -> Tuple[ir.PlanNode, Dict[int, int]]:
+    """Rewrite ``node`` so its output contains at least ``required``
+    (possibly fewer columns than before); returns the node plus an
+    old→new position mapping covering ``required``."""
+    if isinstance(node, ir.Scan):
+        if required >= set(range(node.width)):
+            return node, _identity(node.width)
+        keep = sorted(required)
+        stats.columns_pruned += node.width - len(keep)
+        return ir.Project(node, keep), {p: i for i, p in enumerate(keep)}
+
+    if isinstance(node, ir.Project):
+        child_req = {node.cols[p] for p in required}
+        c, m = _prune(node.children[0], child_req, stats)
+        keep = sorted(required)
+        out = ir.Project(c, [m[node.cols[p]] for p in keep])
+        return out, {p: i for i, p in enumerate(keep)}
+
+    if isinstance(node, ir.Filter):
+        need = required | node.expr.columns()
+        c, m = _prune(node.children[0], need, stats)
+        return ir.Filter(c, node.expr.remap(m)), dict(m)
+
+    if isinstance(node, ir.Shuffle):
+        need = required | set(node.keys)
+        c, m = _prune(node.children[0], need, stats)
+        if c.width > len({m[p] for p in need}):
+            # the child kept columns only IT needed (filter predicate
+            # inputs, say) — project them away BEFORE the exchange so
+            # they never cross the mesh
+            keep = sorted({m[p] for p in need})
+            stats.columns_pruned += c.width - len(keep)
+            c = ir.Project(c, keep)
+            m = {p: keep.index(m[p]) for p in need}
+        return ir.Shuffle(c, [m[k] for k in node.keys]), dict(m)
+
+    if isinstance(node, ir.Join):
+        nl = node.children[0].width
+        lneed = {p for p in required if p < nl} | set(node.left_on)
+        rneed = {p - nl for p in required if p >= nl} | set(node.right_on)
+        l, lm = _prune(node.children[0], lneed, stats)
+        r, rm = _prune(node.children[1], rneed, stats)
+        out = ir.Join(l, r, [lm[k] for k in node.left_on],
+                      [rm[k] for k in node.right_on], node.how,
+                      node.algorithm)
+        mapping = {}
+        for p in required:
+            mapping[p] = lm[p] if p < nl else l.width + rm[p - nl]
+        return out, mapping
+
+    if isinstance(node, ir.GroupBy):
+        need = set(node.keys) | set(node.agg_cols)
+        c, m = _prune(node.children[0], need, stats)
+        out = ir.GroupBy(c, [m[k] for k in node.keys],
+                         [m[a] for a in node.agg_cols], node.ops)
+        return out, _identity(node.width)
+
+    if isinstance(node, ir.SetOp):
+        # row identity spans every column — nothing prunable below
+        l, _lm = _prune(node.children[0],
+                        set(range(node.children[0].width)), stats)
+        r, _rm = _prune(node.children[1],
+                        set(range(node.children[1].width)), stats)
+        return ir.SetOp(l, r, node.op), _identity(node.width)
+
+    if isinstance(node, ir.Sort):
+        need = required | set(node.by)
+        c, m = _prune(node.children[0], need, stats)
+        return ir.Sort(c, [m[b] for b in node.by], node.ascending), dict(m)
+
+    raise AssertionError(f"unhandled node {type(node).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# pass 4: partitioning propagation + shuffle elision
+# ---------------------------------------------------------------------------
+
+
+def _hashable_keys(node: ir.PlanNode, keys) -> bool:
+    """A placement witness can only exist for non-string key columns
+    (shard.partition_signature semantics)."""
+    return all(node.types[k] != ir.STR_TYPE for k in keys)
+
+
+def _propagate(node: ir.PlanNode, world: int) -> Optional[Tuple[int, ...]]:
+    pbs = [_propagate(c, world) for c in node.children]
+    pb: Optional[Tuple[int, ...]] = None
+    if isinstance(node, ir.Scan):
+        sig = node.witness_sig
+        if sig is not None and sig[2] == world:
+            pb = tuple(int(i) for i in sig[0])
+    elif isinstance(node, ir.Project):
+        cpb = pbs[0]
+        if cpb is not None and all(k in node.cols for k in cpb):
+            pb = tuple(node.cols.index(k) for k in cpb)
+    elif isinstance(node, ir.Filter):
+        pb = pbs[0]
+    elif isinstance(node, ir.Shuffle):
+        if _hashable_keys(node, node.keys):
+            pb = tuple(node.keys)
+    elif isinstance(node, ir.Join):
+        l, r = node.children
+        # dtype-equal key pairs only: a promoting alignment hashes the
+        # promoted bits, which the output column (original dtype) would
+        # not reproduce — mirror of the runtime witness's dtype check
+        dtypes_ok = all(l.types[li] == r.types[rj]
+                        for li, rj in zip(node.left_on, node.right_on))
+        if dtypes_ok and world > 1:
+            if node.how in ("inner", "left") and \
+                    _hashable_keys(l, node.left_on):
+                pb = tuple(node.left_on)
+            elif node.how == "right" and _hashable_keys(r, node.right_on):
+                pb = tuple(l.width + j for j in node.right_on)
+    elif isinstance(node, ir.GroupBy):
+        if world > 1 and _hashable_keys(node.children[0], node.keys):
+            pb = tuple(range(len(node.keys)))
+    # SetOp / Sort: no witness survives (set-op output carries no
+    # runtime witness; sort is range-, not hash-partitioned)
+    node.partitioned_by = pb
+    return pb
+
+
+def elide_shuffles(root: ir.PlanNode, world: int,
+                   stats: PlanStats) -> ir.PlanNode:
+    _propagate(root, world)
+
+    def rewrite(node: ir.PlanNode) -> ir.PlanNode:
+        node.children = [rewrite(c) for c in node.children]
+        if isinstance(node, ir.Join):
+            # delete satisfied Shuffle markers under joins only: the
+            # fold into distributed_join re-verifies via the runtime
+            # witness (a stale claim degrades to an extra exchange).
+            # STANDALONE Shuffles are never plan-deleted — the executor
+            # re-checks the runtime witness and skipping there is free
+            # (dist_ops.shuffle skips witnessed inputs anyway), whereas
+            # plan-time deletion would trust a scan-time snapshot that
+            # a registry rebind could invalidate.
+            for side in (0, 1):
+                c = node.children[side]
+                if isinstance(c, ir.Shuffle):
+                    cpb = c.children[0].partitioned_by
+                    if cpb is not None and cpb == tuple(c.keys):
+                        node.children[side] = c.children[0]
+                        stats.shuffles_elided += 1
+        if isinstance(node, ir.GroupBy):
+            cpb = node.children[0].partitioned_by
+            if world > 1 and cpb is not None and cpb == tuple(node.keys):
+                node.local_ok = True
+                stats.groupbys_localized += 1
+        return node
+
+    root = rewrite(root)
+    _propagate(root, world)  # refresh metadata on the rewritten tree
+    return root
+
+
+def optimize(root: ir.PlanNode, world: int
+             ) -> Tuple[ir.PlanNode, PlanStats]:
+    """Run all passes; returns the optimized plan and its stats."""
+    stats = PlanStats()
+    root = insert_shuffles(root, world, stats)
+    root = pushdown_filters(root, stats)
+    root = prune_projections(root, stats)
+    root = elide_shuffles(root, world, stats)
+    return root, stats

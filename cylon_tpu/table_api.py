@@ -156,6 +156,26 @@ def merge_tables(table_ids: List[str], out_id: str,
     return Status.OK()
 
 
+# ---------------------------------------------------------------------------
+# lazy plan facade (cylon_tpu/plan) — id-keyed like every wrapper here
+# ---------------------------------------------------------------------------
+
+def lazy_table(table_id: str):
+    """Start a lazy query plan over a registered table; build the
+    pipeline with LazyTable methods and finish with
+    ``execute(out_id=...)`` to register the result."""
+    from .plan import scan
+
+    return scan(table_id)
+
+
+def execute_plan(lazy, out_id: str) -> Status:
+    """Optimize + execute a `LazyTable` pipeline, registering the
+    result under ``out_id``."""
+    lazy.execute(out_id=out_id)
+    return Status.OK()
+
+
 def row_count(table_id: str) -> int:
     return get_table(table_id).row_count
 

@@ -30,6 +30,37 @@ import jax
 
 logger = logging.getLogger("cylon_tpu")
 
+# active phase collectors (collect_phases contexts) — phase() appends
+# every entered label to each, so callers can COUNT events (e.g. a
+# query plan's shuffles) without wiring a logging handler
+_collectors: list = []
+
+
+class collect_phases:
+    """Collect every phase label entered inside the context — the
+    programmatic mirror of the INFO log stream. ``count(prefix)``
+    answers questions like "how many shuffles did this plan run?"
+    (prefix="plan.shuffle"); labels keep their ``name#seq`` form."""
+
+    def __init__(self):
+        self.labels: list = []
+
+    def __enter__(self) -> "collect_phases":
+        _collectors.append(self.labels)
+        return self
+
+    def __exit__(self, *exc):
+        # remove by IDENTITY: list.remove compares by ==, and two nested
+        # collectors with equal contents would remove each other's lists
+        for i, l in enumerate(_collectors):
+            if l is self.labels:
+                del _collectors[i]
+                break
+        return False
+
+    def count(self, prefix: str) -> int:
+        return sum(1 for l in self.labels if l.startswith(prefix))
+
 
 def log_to_stderr(level: int = logging.INFO) -> None:
     """Convenience: route cylon_tpu phase logs to stderr (idempotent)."""
@@ -46,6 +77,8 @@ def log_to_stderr(level: int = logging.INFO) -> None:
 def phase(name: str, seq: Optional[int] = None) -> Iterator[None]:
     """Time one operator phase; annotate device traces with the same label."""
     label = f"{name}#{seq}" if seq is not None else name
+    for c in _collectors:
+        c.append(label)
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(f"cylon:{label}"):
         yield

@@ -1,0 +1,34 @@
+"""Lazy query-plan example: join → groupby with ONE shuffle.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python examples/plan_pipeline_example.py
+"""
+import numpy as np
+
+import cylon_tpu as ct
+from cylon_tpu import plan, telemetry
+from cylon_tpu.plan import col
+
+ctx = ct.CylonContext.InitDistributed(ct.TPUConfig())
+rng = np.random.default_rng(0)
+n = 100_000
+
+orders = ct.Table.from_pydict(ctx, {
+    "user": rng.integers(0, n // 8, n).astype(np.int32),
+    "amount": rng.exponential(40.0, n).astype(np.float32),
+    "region": rng.integers(0, 5, n).astype(np.int32)})
+users = ct.Table.from_pydict(ctx, {
+    "user": np.arange(n // 8, dtype=np.int32),
+    "score": rng.integers(0, 100, n // 8).astype(np.int32)})
+
+pipe = (plan.scan(orders)
+        .filter(col("region") < 3)          # pushed below the shuffle
+        .join(plan.scan(users), on="user")
+        .groupby("lt-0", ["lt-1"], ["sum"]))  # same keys: no 2nd shuffle
+
+print(pipe.explain())
+print()
+with telemetry.collect_phases() as cp:
+    result = pipe.execute()
+print(f"rows: {result.row_count}, "
+      f"exchange stages: {cp.count('plan.shuffle')}")

@@ -1,0 +1,13 @@
+#!/bin/sh
+# One-stop verification gate: lint + tier-1 tests (ROADMAP.md).
+# Usage: sh scripts/check.sh
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== lint: plan-layer import boundary =="
+python scripts/check_plan_imports.py
+
+echo "== tier-1 tests =="
+JAX_PLATFORMS=cpu exec python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly

@@ -25,7 +25,12 @@ if not TPU_MODE:
     # runs, in which case the env vars above were read too late — set via
     # config too.
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        # newer jax spells the virtual-device count as a config option;
+        # older releases only honor the XLA_FLAGS form set above
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass
     # x64 stays OFF in TPU mode (Mosaic rejects 64-bit converts)
     jax.config.update("jax_enable_x64", True)
 

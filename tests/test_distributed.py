@@ -711,3 +711,45 @@ def test_distribute_by_key_varbytes(dist_ctx, monkeypatch):
     got = out.to_pydict()
     assert sorted(zip(got["k"], map(int, got["v"]))) == \
         sorted(zip(keys, range(n)))
+
+
+def test_exact_redo_schema_and_free(dist_ctx):
+    """The exact-join collision recovery path (_exact_dict_redo) must
+    return varbytes key columns like the normal path and free
+    retain=False inputs after the redo (ADVICE r5 low). Exercised
+    directly — a real 96-bit collision is ~unobservable."""
+    from cylon_tpu.ops.join import JoinAlgorithm, JoinConfig, JoinType
+    from cylon_tpu.parallel.dist_ops import _exact_dict_redo
+
+    rng = np.random.default_rng(31)
+    n = 400
+    pool = [f"key-{i:04d}-" + "q" * 24 for i in range(64)]  # > 20 bytes
+
+    def make(lo, hi, name):
+        ks = np.array([pool[i] for i in rng.integers(lo, hi, n)], object)
+        from cylon_tpu.data.column import Column
+        from cylon_tpu.data.strings import VarBytes
+        from cylon_tpu.data.table import Table
+
+        return Table([
+            Column.from_varbytes(VarBytes.from_host(list(ks)), None, "k"),
+            Column.from_numpy(np.arange(n) + lo, name)], dist_ctx)
+
+    left = make(0, 48, "v")
+    right = make(16, 64, "w")
+    exp = left.distributed_join(right, "left", on="k").to_pandas()
+
+    rng = np.random.default_rng(31)  # same key draws again
+    left2 = make(0, 48, "v")
+    right2 = make(16, 64, "w")
+    left2.retain_memory(False)
+    cfg = JoinConfig(JoinType.LEFT, [0], [0], JoinAlgorithm.SORT,
+                     exact=True)
+    res = _exact_dict_redo(left2, right2, cfg, [(0, 0)],
+                           force_exchange=False)
+    nl = 2
+    assert res.get_column(0).is_varbytes, "left key not varbytes"
+    assert res.get_column(nl).is_varbytes, "right key not varbytes"
+    assert left2.column_count == 0, "retain=False input not freed"
+    assert right2.column_count == 2, "retained input wrongly freed"
+    assert_rows_equal(res.to_pandas(), exp, msg="exact redo vs normal")

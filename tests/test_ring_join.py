@@ -160,7 +160,9 @@ def test_ring_join_varbytes_key_and_payload(dctx, monkeypatch):
             pd.DataFrame({"k": rk, "w": np.arange(n) * 3}), on="k", how=how)
         assert len(got) == len(exp), (jt, len(got), len(exp))
         assert sorted(got.iloc[:, 0].dropna()) == sorted(exp["k"])
-        # payload strings stayed attached to their rows
+        # payload strings stayed attached to their rows (address the
+        # string column by name — pandas versions disagree on whether an
+        # external-Series grouper column survives in the result)
         gm = got.groupby(got.iloc[:, 1]).first()
         em = exp.groupby("v").first()
-        assert dict(gm.iloc[:, 1]) == dict(em["s"])
+        assert dict(gm["lt-2"]) == dict(em["s"])
