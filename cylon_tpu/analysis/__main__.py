@@ -1,0 +1,97 @@
+"""CLI: ``python -m cylon_tpu.analysis`` — run the static-analysis
+suite; exit 0 iff no unsuppressed finding.
+
+Wired into scripts/check.sh ahead of tier-1. Typical invocations:
+
+    python -m cylon_tpu.analysis                    # full suite
+    python -m cylon_tpu.analysis --json             # machine-readable
+    python -m cylon_tpu.analysis --families layering,hostsync
+    python -m cylon_tpu.analysis --package-root tests/analysis_fixtures/pkg_bad
+    python -m cylon_tpu.analysis --list-rules
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    # the collectives checker wants virtual host devices; the flag only
+    # takes effect if the jax backend has not initialized yet, which is
+    # the case here (importing cylon_tpu imports jax but touches no
+    # device until a kernel runs)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+            " --xla_force_host_platform_device_count=8"
+
+    p = argparse.ArgumentParser(
+        prog="python -m cylon_tpu.analysis",
+        description="cylon_tpu static-analysis suite "
+                    "(docs/analysis.md)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (stable schema, "
+                        "docs/analysis.md)")
+    p.add_argument("--families",
+                   help="comma-separated checker families to run "
+                        "(default: all registered)")
+    p.add_argument("--package-root",
+                   help="package tree to scan (default: the installed "
+                        "cylon_tpu package); fixture trees use this")
+    p.add_argument("--collectives-entry-module",
+                   help="fixture module file declaring ENTRY_POINTS "
+                        "for the collectives checker")
+    p.add_argument("--witness-plan-module",
+                   help="fixture module file declaring build_plans() "
+                        "for the witness checker")
+    p.add_argument("--world", type=int, default=4,
+                   help="virtual mesh width for semantic checkers")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print registered checker families and exit")
+    args = p.parse_args(argv)
+
+    from . import AnalysisContext, CHECKERS, run_checkers, to_json_text
+
+    if args.list_rules:
+        for name in sorted(CHECKERS):
+            doc = (sys.modules[CHECKERS[name].__module__].__doc__ or
+                   "").strip().splitlines()[0]
+            print(f"{name:12s} {doc}")
+        return 0
+
+    if args.package_root:
+        root = args.package_root
+    else:
+        import cylon_tpu
+
+        root = os.path.dirname(os.path.abspath(cylon_tpu.__file__))
+
+    options = {"world": args.world}
+    if args.collectives_entry_module:
+        options["collectives_entry_module"] = args.collectives_entry_module
+    if args.witness_plan_module:
+        options["witness_plan_module"] = args.witness_plan_module
+
+    families = args.families.split(",") if args.families else None
+    if args.package_root and families is None and \
+            not (args.collectives_entry_module or
+                 args.witness_plan_module):
+        # scanning a fixture/foreign tree: the semantic checkers
+        # (collectives/witness) are about the REAL package's kernels
+        # and optimizer — run only the file-scanning families
+        families = ["layering", "hostsync"]
+
+    ctx = AnalysisContext(root, options)
+    try:
+        res = run_checkers(ctx, families)
+    except ValueError as e:  # unknown --families entry
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(to_json_text(res) if args.json else res.format_text())
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,280 @@
+"""Analysis framework core: findings, suppressions, checker registry.
+
+A *checker* is a function ``(AnalysisContext) -> list[Finding]``
+registered under a family name ("layering", "hostsync", ...). The CLI
+(`python -m cylon_tpu.analysis`) runs every registered checker and
+exits non-zero when any unsuppressed finding survives; tests drive the
+same API directly against fixture trees with seeded violations.
+
+Suppression syntax (mirrors the familiar linter discipline):
+
+* ``# cylint: disable=<rule>[,<rule>...]`` on the offending line
+  suppresses those rules for that line only;
+* ``# cylint: disable-file=<rule>[,<rule>...]`` anywhere in a file
+  (conventionally the top) suppresses for the whole file.
+
+A ``<rule>`` is either a full rule id (``layering/plan-no-ops``), a
+family name (``layering`` — every rule in the family), or ``all``.
+Suppressions are deliberately per-rule: a bare ``# cylint: disable``
+with no rule is ignored (and reported), so silencing is always an
+explicit, reviewable decision.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+# JSON output schema version — tests pin this; bump only with a
+# deliberate, documented schema change (docs/analysis.md).
+SCHEMA_VERSION = 1
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*cylint:\s*(disable|disable-file)=([A-Za-z0-9_\-/,*]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``rule`` is ``<family>/<name>``; ``path`` is repo/package-relative
+    for display (checkers that analyze traced programs rather than
+    files point at the factory's def line)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    col: int = 0
+
+    @property
+    def family(self) -> str:
+        return self.rule.split("/", 1)[0]
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+
+def _rule_matches(entry: str, rule: str) -> bool:
+    if entry == "all" or entry == "*":
+        return True
+    if entry == rule:
+        return True
+    # family name, or explicit family wildcard ("layering/*")
+    fam = entry[:-2] if entry.endswith("/*") else entry
+    return "/" not in fam and rule.split("/", 1)[0] == fam
+
+
+class Suppressions:
+    """Per-file suppression index parsed straight from source text."""
+
+    def __init__(self, source: str):
+        self.line_rules: Dict[int, List[str]] = {}
+        self.file_rules: List[str] = []
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            kind, rules = m.group(1), m.group(2).split(",")
+            rules = [r.strip() for r in rules if r.strip()]
+            if kind == "disable-file":
+                self.file_rules.extend(rules)
+            else:
+                self.line_rules.setdefault(i, []).extend(rules)
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        for entry in self.file_rules:
+            if _rule_matches(entry, finding.rule):
+                return True
+        for entry in self.line_rules.get(finding.line, ()):
+            if _rule_matches(entry, finding.rule):
+                return True
+        return False
+
+
+@dataclass
+class SourceFile:
+    path: str         # absolute
+    rel: str          # package-root-relative, '/'-separated
+    source: str
+    tree: ast.AST
+    suppressions: Suppressions
+
+
+class AnalysisContext:
+    """Shared state for one analysis run.
+
+    ``package_root`` is the directory whose layout defines subsystems
+    (``ops/``, ``plan/``, ...) — the installed ``cylon_tpu`` package by
+    default, a fixture tree with the same shape under test. ``options``
+    carries checker-specific knobs (fixture entry-point modules, world
+    size, ...).
+    """
+
+    def __init__(self, package_root: str, options: Optional[dict] = None):
+        self.package_root = os.path.abspath(package_root)
+        self.package_name = os.path.basename(self.package_root)
+        self.options = dict(options or {})
+        self._files: Optional[List[SourceFile]] = None
+
+    def files(self) -> List[SourceFile]:
+        if self._files is None:
+            out = []
+            for root, dirs, names in os.walk(self.package_root):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", "_native"))
+                for name in sorted(names):
+                    if not name.endswith(".py"):
+                        continue
+                    path = os.path.join(root, name)
+                    rel = os.path.relpath(path, self.package_root)
+                    rel = rel.replace(os.sep, "/")
+                    src = open(path, encoding="utf-8").read()
+                    try:
+                        tree = ast.parse(src, filename=path)
+                    except SyntaxError as e:  # pragma: no cover
+                        raise RuntimeError(f"cannot parse {path}: {e}")
+                    out.append(SourceFile(path, rel, src, tree,
+                                          Suppressions(src)))
+            self._files = out
+        return self._files
+
+    def module_name(self, f: SourceFile) -> str:
+        """Package-relative dotted module path ('' for __init__)."""
+        mod = f.rel[:-3].replace("/", ".")
+        if mod.endswith("__init__"):
+            mod = mod[: -len("__init__")].rstrip(".")
+        return mod
+
+
+# ---------------------------------------------------------------------------
+# shared import resolution (used by the layering and hostsync passes —
+# ONE copy, so the two checkers can never disagree about what module an
+# import statement targets)
+# ---------------------------------------------------------------------------
+
+
+def importer_package(rel: str, modname: str) -> str:
+    """Package-relative dotted path of a file's PACKAGE — the anchor a
+    level-1 relative import resolves against. For ``pkg/sub/x.py`` that
+    is ``sub``; for ``pkg/sub/__init__.py`` it is also ``sub`` (a
+    package's relative imports anchor at itself)."""
+    if rel.endswith("__init__.py"):
+        return modname
+    return ".".join(modname.split(".")[:-1]) if modname else ""
+
+
+def resolve_import(module: Optional[str], level: int, importer_pkg: str,
+                   package: str) -> Optional[str]:
+    """Resolve an import statement to a *package-relative* dotted path
+    ('' = the package root), or None when it leaves the package.
+    ``importer_pkg`` is the importing file's package (see
+    importer_package); ``level`` is the ImportFrom relative level (0
+    for absolute)."""
+    if level == 0:
+        name = module or ""
+        if name == package:
+            return ""
+        if name.startswith(package + "."):
+            return name[len(package) + 1:]
+        return None
+    # relative: level 1 anchors at the importer's own package, each
+    # further level climbs one package
+    parts = importer_pkg.split(".") if importer_pkg else []
+    anchor = parts[: max(len(parts) - (level - 1), 0)]
+    return ".".join(anchor + ([module] if module else []))
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+CheckerFn = Callable[[AnalysisContext], List[Finding]]
+CHECKERS: Dict[str, CheckerFn] = {}
+
+
+def register(family: str):
+    def deco(fn: CheckerFn) -> CheckerFn:
+        CHECKERS[family] = fn
+        return fn
+    return deco
+
+
+@dataclass
+class RunResult:
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    checkers: List[str] = field(default_factory=list)
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> dict:
+        counts: Dict[str, int] = {}
+        for f in self.findings:
+            counts[f.family] = counts.get(f.family, 0) + 1
+        return {
+            "version": SCHEMA_VERSION,
+            "ok": self.ok,
+            "checkers": list(self.checkers),
+            "counts": counts,
+            "suppressed": self.suppressed,
+            "notes": list(self.notes),
+            "findings": [f.to_json() for f in self.findings],
+        }
+
+    def format_text(self) -> str:
+        lines = []
+        for f in self.findings:
+            lines.append(f.format())
+        lines.append(f"cylint: {len(self.findings)} finding(s), "
+                     f"{self.suppressed} suppressed "
+                     f"[{', '.join(self.checkers)}]")
+        for n in self.notes:
+            lines.append(f"note: {n}")
+        return "\n".join(lines)
+
+
+def run_checkers(ctx: AnalysisContext,
+                 families: Optional[Sequence[str]] = None) -> RunResult:
+    """Run the selected checker families (default: all registered) and
+    apply suppressions. Findings sort by (path, line, rule) so output
+    (and the JSON schema) is deterministic. Unknown family names raise:
+    a typo in a CI config must not become an exit-0 gate that ran
+    nothing."""
+    if families is not None:
+        unknown = sorted(set(families) - set(CHECKERS))
+        if unknown:
+            raise ValueError(
+                f"unknown checker families {unknown}; registered: "
+                f"{sorted(CHECKERS)}")
+    res = RunResult()
+    by_path = {f.rel: f for f in ctx.files()}
+    for name in sorted(CHECKERS):
+        if families is not None and name not in families:
+            continue
+        res.checkers.append(name)
+        for finding in CHECKERS[name](ctx):
+            sf = by_path.get(finding.path)
+            if sf is not None and sf.suppressions.is_suppressed(finding):
+                res.suppressed += 1
+                continue
+            res.findings.append(finding)
+    res.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    # checkers accumulate informational notes (coverage gaps, skipped
+    # TPU-only entries, corpus sizes) in ctx.options["notes"]
+    res.notes.extend(ctx.options.pop("notes", []))
+    return res
+
+
+def to_json_text(res: RunResult) -> str:
+    return json.dumps(res.to_json(), indent=2, sort_keys=True)

@@ -1,0 +1,332 @@
+"""Host-sync detector: host transfers inside traced (device) code.
+
+`np.asarray` / `jax.device_get` / `.item()` / `float()` on a traced
+value forces a device→host round trip (or a trace-time
+ConcretizationTypeError on a path no test exercises). The framework's
+discipline is that host syncs happen at exactly the declared points —
+the count→capacity fetches between kernel phases — and NEVER inside
+code that runs under `jit` / `shard_map` / `pallas_call`.
+
+The pass is purely syntactic (nothing is imported):
+
+1. *Trace roots.* A function is traced when it is decorated with
+   ``jax.jit`` (or ``partial(jax.jit, ...)``), or its NAME is passed to
+   ``jax.jit`` / ``shard_map`` / ``pl.pallas_call`` / a ``jax.lax``
+   control-flow combinator — the repo's universal kernel-factory shape
+   (``def kernel(...)`` then ``jax.jit(shard_map(kernel, ...))``).
+2. *Closure.* Calls from a traced body to module-level functions —
+   directly (``_bucket_sort(...)``) or through an intra-package module
+   alias (``_join.join_plan_keys(...)``, resolved via each module's
+   import table) — mark the callee traced too, transitively across the
+   package. Nested ``def``s and lambdas inside a traced body are
+   covered by walking the whole body.
+3. *Flag.* Within traced code: ``np.asarray`` / ``np.array`` /
+   ``np.ascontiguousarray``, ``jax.device_get``, ``.item()`` /
+   ``.tolist()``, and ``float()/int()/bool()`` on non-static arguments
+   (shape/ndim/len() expressions are static under trace and stay
+   legal).
+
+Host-side call sites — the overwhelming majority of the ~120
+`np.asarray`/`device_get` sites in the package — are by construction
+never flagged: they live outside any traced closure. Each finding
+reports the trace chain (root → callee) so a false positive is cheap
+to triage; a justified one takes a per-line ``# cylint:
+disable=hostsync/...`` with a comment.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (AnalysisContext, Finding, importer_package, register,
+                   resolve_import)
+
+# call targets whose function-valued arguments become traced
+_TRACING_CALLS = {
+    ("jax", "jit"), ("jit",), ("shard_map",), ("jax", "vmap"),
+    ("pl", "pallas_call"), ("pallas_call",),
+    ("jax", "lax", "fori_loop"), ("jax", "lax", "while_loop"),
+    ("jax", "lax", "cond"), ("jax", "lax", "scan"),
+    ("jax", "lax", "switch"), ("lax", "fori_loop"), ("lax", "cond"),
+    ("lax", "scan"), ("lax", "while_loop"), ("lax", "switch"),
+    ("jax", "checkpoint"), ("jax", "remat"),
+}
+
+# attribute-call chains that ARE a host sync
+_SYNC_CALLS = {
+    ("np", "asarray"), ("np", "array"), ("np", "ascontiguousarray"),
+    ("numpy", "asarray"), ("numpy", "array"),
+    ("jax", "device_get"),
+}
+
+_SYNC_METHODS = {"item", "tolist"}
+
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+def _attr_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """('jax','lax','psum') for jax.lax.psum; ('f',) for bare names."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    chain = _attr_chain(dec)
+    if chain in (("jax", "jit"), ("jit",)):
+        return True
+    if isinstance(dec, ast.Call):
+        inner = _attr_chain(dec.func)
+        if inner in (("jax", "jit"), ("jit",)):
+            return True
+        # partial(jax.jit, static_argnames=...)
+        if inner in (("partial",), ("functools", "partial")) and dec.args:
+            return _attr_chain(dec.args[0]) in (("jax", "jit"), ("jit",))
+    return False
+
+
+def _static_params(fn: ast.AST) -> Set[str]:
+    """Parameters of ``fn`` that are static under tracing: annotated as
+    a scalar Python type (``max_e: int``), or named in the function's
+    own ``jax.jit(static_argnames=...)`` decorator (enum/config args)."""
+    out: Set[str] = set()
+    args = fn.args
+    all_args = list(args.posonlyargs) + list(args.args) \
+        + list(args.kwonlyargs)
+    for a in all_args:
+        ann = a.annotation
+        if isinstance(ann, ast.Name) and ann.id in ("int", "float",
+                                                    "bool", "str"):
+            out.add(a.arg)
+    for dec in fn.decorator_list:
+        if not (isinstance(dec, ast.Call) and _is_jit_decorator(dec)):
+            continue
+        for kw in dec.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                names = kw.value.elts \
+                    if isinstance(kw.value, (ast.Tuple, ast.List)) \
+                    else [kw.value]
+                for n in names:
+                    if isinstance(n, ast.Constant):
+                        if isinstance(n.value, str):
+                            out.add(n.value)
+                        elif isinstance(n.value, int) and \
+                                n.value < len(all_args):
+                            out.add(all_args[n.value].arg)
+    return out
+
+
+def _is_staticish(node: ast.AST, static_names: Set[str] = frozenset()
+                  ) -> bool:
+    """Expressions that stay concrete under tracing: literals, shape /
+    ndim / size / itemsize introspection, len(), statically-annotated
+    parameters, and arithmetic over those. Conservative: anything else
+    is treated as possibly traced."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name) and node.id in static_names:
+        return True
+    if isinstance(node, ast.Attribute):
+        if node.attr in ("ndim", "size", "itemsize", "dtype"):
+            return True
+        if node.attr == "shape":
+            return True
+        return _is_staticish(node.value, static_names) and \
+            node.attr.isidentifier()
+    if isinstance(node, ast.Subscript):
+        return isinstance(node.value, ast.Attribute) and \
+            node.value.attr == "shape"
+    if isinstance(node, ast.Call):
+        chain = _attr_chain(node.func)
+        if chain in (("len",), ("int",), ("float",), ("max",), ("min",)):
+            return all(_is_staticish(a, static_names) for a in node.args)
+        return False
+    if isinstance(node, ast.BinOp):
+        return _is_staticish(node.left, static_names) and \
+            _is_staticish(node.right, static_names)
+    if isinstance(node, ast.UnaryOp):
+        return _is_staticish(node.operand, static_names)
+    return False
+
+
+class _Module:
+    """Per-file symbol tables for the closure pass."""
+
+    def __init__(self, sf, modname: str, package: str):
+        self.sf = sf
+        self.modname = modname
+        # module-level (and class-level is irrelevant here) functions
+        self.functions: Dict[str, ast.AST] = {}
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+        # local alias -> package-relative module path, for call
+        # resolution of `_join.join_plan_keys(...)`
+        self.mod_aliases: Dict[str, str] = {}
+        # local name -> (module path, function name) from
+        # `from ..ops.join import gather_columns as _gather`
+        self.fn_imports: Dict[str, Tuple[str, str]] = {}
+        pkg = importer_package(sf.rel, modname)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    target = resolve_import(a.name, 0, pkg, package)
+                    if target:  # intra-package, below the root
+                        self.mod_aliases[a.asname
+                                         or a.name.split(".")[-1]] = target
+            elif isinstance(node, ast.ImportFrom):
+                base = resolve_import(node.module or "", node.level, pkg,
+                                      package)
+                if base is None:
+                    continue
+                for a in node.names:
+                    sub = (base + "." + a.name) if base else a.name
+                    local = a.asname or a.name
+                    # imported name could be a submodule or a function;
+                    # record both interpretations, resolved lazily
+                    self.mod_aliases.setdefault(local, sub)
+                    self.fn_imports[local] = (base, a.name)
+
+
+def _trace_roots(mod: _Module) -> Set[str]:
+    """Names of this module's functions that enter tracing directly."""
+    roots: Set[str] = set()
+    for name, fn in mod.functions.items():
+        if any(_is_jit_decorator(d) for d in fn.decorator_list):
+            roots.add(name)
+    for node in ast.walk(mod.sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain is None or chain not in _TRACING_CALLS:
+            continue
+        for arg in node.args:
+            inner = _attr_chain(arg)
+            if inner is not None and len(inner) == 1:
+                roots.add(inner[0])
+    return roots
+
+
+def _called_functions(body: ast.AST, mod: _Module
+                      ) -> Set[Tuple[str, str]]:
+    """(module path, function name) pairs this traced body calls —
+    same-module calls plus intra-package `alias.fn(...)` calls."""
+    out: Set[Tuple[str, str]] = set()
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain is None:
+            continue
+        if len(chain) == 1:
+            name = chain[0]
+            if name in mod.functions:
+                out.add((mod.modname, name))
+            elif name in mod.fn_imports:
+                out.add(mod.fn_imports[name])
+        elif len(chain) == 2 and chain[0] in mod.mod_aliases:
+            out.add((mod.mod_aliases[chain[0]], chain[1]))
+    return out
+
+
+def _scan_body(fn: ast.AST, mod: _Module, chain_desc: str
+               ) -> List[Finding]:
+    out: List[Finding] = []
+    # static parameters of this function and every def nested in it
+    # (kernel factories close over static config; a per-scope walk
+    # would be more precise but name collisions are not a real risk)
+    static_names: Set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            static_names |= _static_params(sub)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        where = f" [traced via {chain_desc}]" if chain_desc else ""
+        if chain in _SYNC_CALLS:
+            out.append(Finding(
+                rule="hostsync/transfer", path=mod.sf.rel,
+                line=node.lineno,
+                message=f"{'.'.join(chain)}() inside traced code forces "
+                        f"a device→host transfer{where}"))
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS and not node.args:
+            out.append(Finding(
+                rule="hostsync/transfer", path=mod.sf.rel,
+                line=node.lineno,
+                message=f".{node.func.attr}() inside traced code forces "
+                        f"a device→host transfer{where}"))
+        elif chain is not None and len(chain) == 1 and \
+                chain[0] in _CAST_BUILTINS and node.args:
+            if not all(_is_staticish(a, static_names) for a in node.args):
+                out.append(Finding(
+                    rule="hostsync/concretize", path=mod.sf.rel,
+                    line=node.lineno,
+                    message=f"{chain[0]}() on a possibly-traced value "
+                            f"inside traced code concretizes (host "
+                            f"sync or trace error){where}"))
+    return out
+
+
+@register("hostsync")
+def check_hostsync(ctx: AnalysisContext) -> List[Finding]:
+    package = ctx.package_name
+    modules: Dict[str, _Module] = {}
+    for sf in ctx.files():
+        modname = ctx.module_name(sf)
+        modules[modname] = _Module(sf, modname, package)
+
+    # seed with direct trace roots, then close over the call graph
+    traced: Dict[Tuple[str, str], str] = {}   # (mod, fn) -> chain desc
+    work: List[Tuple[str, str]] = []
+    for modname, mod in modules.items():
+        for name in _trace_roots(mod):
+            if name in mod.functions:
+                key = (modname, name)
+                traced[key] = name
+                work.append(key)
+    while work:
+        modname, fname = work.pop()
+        mod = modules.get(modname)
+        if mod is None or fname not in mod.functions:
+            continue
+        desc = traced[(modname, fname)]
+        for callee in _called_functions(mod.functions[fname], mod):
+            cmod, cfn = callee
+            target = modules.get(cmod)
+            if target is None or cfn not in target.functions:
+                continue
+            if callee not in traced:
+                traced[callee] = f"{desc} -> {cmod or package}.{cfn}"
+                work.append(callee)
+
+    findings: List[Finding] = []
+    for (modname, fname), desc in sorted(traced.items()):
+        mod = modules[modname]
+        findings.extend(_scan_body(mod.functions[fname], mod, desc))
+
+    # classification summary: every host-transfer call site in the tree
+    # is either inside a traced closure (flagged above) or host-side
+    # (legal — the declared count→capacity syncs between kernel phases)
+    total = 0
+    for sf in ctx.files():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if chain in _SYNC_CALLS or (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SYNC_METHODS
+                        and not node.args):
+                    total += 1
+    flagged = sum(1 for f in findings if f.rule == "hostsync/transfer")
+    ctx.options.setdefault("notes", []).append(
+        f"hostsync: {total} host-transfer call sites; {flagged} inside "
+        f"traced closures (flagged), {total - flagged} host-side (legal); "
+        f"{len(traced)} functions in the traced closure")
+    return findings

@@ -30,6 +30,7 @@ Pass order is load-bearing:
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Set, Tuple
 
@@ -198,9 +199,17 @@ def _propagate(node: ir.PlanNode, world: int) -> Optional[Tuple[int, ...]]:
     pbs = [_propagate(c, world) for c in node.children]
     pb: Optional[Tuple[int, ...]] = None
     if isinstance(node, ir.Scan):
+        # trust the snapshot only when it is CONSISTENT with the scan's
+        # own schema (same checks as plan/verify.derive_witness — the
+        # optimizer must never elide on a witness the verifier rejects):
+        # in-range positions, matching dtypes, hashable (non-string)
         sig = node.witness_sig
         if sig is not None and sig[2] == world:
-            pb = tuple(int(i) for i in sig[0])
+            pos = tuple(int(i) for i in sig[0])
+            if all(p < node.width for p in pos) and \
+                    tuple(sig[1]) == tuple(node.types[p] for p in pos) \
+                    and _hashable_keys(node, pos):
+                pb = pos
     elif isinstance(node, ir.Project):
         cpb = pbs[0]
         if cpb is not None and all(k in node.cols for k in cpb):
@@ -247,9 +256,21 @@ def elide_shuffles(root: ir.PlanNode, world: int,
             # (dist_ops.shuffle skips witnessed inputs anyway), whereas
             # plan-time deletion would trust a scan-time snapshot that
             # a registry rebind could invalidate.
+            #
+            # dtype-equal key pairs only: a promoting alignment hashes
+            # the promoted bits on BOTH sides, so a witness recorded
+            # over the unpromoted dtype does not place rows where the
+            # join's exchange would — the runtime signature (which
+            # hashes ALIGNED dtypes) would reject the skip anyway, and
+            # an elision here would just be a false plan claim (the
+            # witness verifier, plan/verify.py, rejects it).
+            l, r = node.children
+            pair_dtypes_ok = all(
+                l.types[li] == r.types[rj]
+                for li, rj in zip(node.left_on, node.right_on))
             for side in (0, 1):
                 c = node.children[side]
-                if isinstance(c, ir.Shuffle):
+                if isinstance(c, ir.Shuffle) and pair_dtypes_ok:
                     cpb = c.children[0].partitioned_by
                     if cpb is not None and cpb == tuple(c.keys):
                         node.children[side] = c.children[0]
@@ -268,10 +289,20 @@ def elide_shuffles(root: ir.PlanNode, world: int,
 
 def optimize(root: ir.PlanNode, world: int
              ) -> Tuple[ir.PlanNode, PlanStats]:
-    """Run all passes; returns the optimized plan and its stats."""
+    """Run all passes; returns the optimized plan and its stats.
+
+    With ``CYLON_TPU_VERIFY_PLANS=1`` the optimizer-independent witness
+    verifier (plan/verify.py) re-derives every placement witness over
+    the optimized tree and raises on any elision it cannot justify —
+    the debug-mode soundness backstop (tests/conftest.py enables it, so
+    tier-1 exercises the verifier on every planned pipeline)."""
     stats = PlanStats()
     root = insert_shuffles(root, world, stats)
     root = pushdown_filters(root, stats)
     root = prune_projections(root, stats)
     root = elide_shuffles(root, world, stats)
+    if os.environ.get("CYLON_TPU_VERIFY_PLANS") == "1":
+        from .verify import check_plan
+
+        check_plan(root, world)
     return root, stats

@@ -1,11 +1,13 @@
 #!/bin/sh
-# One-stop verification gate: lint + tier-1 tests (ROADMAP.md).
+# One-stop verification gate: static analysis + tier-1 tests (ROADMAP.md).
 # Usage: sh scripts/check.sh
 set -e
 cd "$(dirname "$0")/.."
 
-echo "== lint: plan-layer import boundary =="
-python scripts/check_plan_imports.py
+echo "== static analysis: python -m cylon_tpu.analysis =="
+# all four checker families (layering, hostsync, collectives, witness);
+# any unsuppressed finding fails the gate before tests run
+python -m cylon_tpu.analysis
 
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu exec python -m pytest tests/ -q -m 'not slow' \

@@ -1,63 +1,31 @@
 #!/usr/bin/env python
-"""Lint gate: `cylon_tpu/plan/` must never import `cylon_tpu.ops`.
-
-The plan subsystem's lowering contract is that device kernels are
-reached ONLY through `parallel/dist_ops`, `data/table`, and
-`table_api` — the layers that own key preparation, shuffle routing and
-capacity policy. A plan module importing an `ops/` kernel directly
-would bypass those invariants (lane pairing, witness semantics,
-emit-mask discipline) and silently fork the execution paths the
-bit-identity tests compare. Fails (exit 1) listing every offending
-import; AST-based, so aliases and `from ... import` forms are caught.
+"""Thin compatibility shim: the plan→ops import gate now lives in the
+static-analysis suite as the ``layering/plan-no-ops`` rule
+(cylon_tpu/analysis/layering.py — one contract in the declarative
+per-subsystem table; docs/analysis.md). This wrapper keeps the old
+entry point and output contract for existing workflows; new callers
+should run ``python -m cylon_tpu.analysis`` and get every contract.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PLAN_DIR = os.path.join(REPO, "cylon_tpu", "plan")
-
-# module paths (absolute or package-relative) that plan/ may not touch
-FORBIDDEN = ("cylon_tpu.ops",)
-
-
-def _is_forbidden(modname: str, level: int, fname: str) -> bool:
-    if level == 0:
-        return any(modname == f or modname.startswith(f + ".")
-                   for f in FORBIDDEN)
-    # relative import from cylon_tpu/plan/x.py: level 1 → cylon_tpu.plan,
-    # level 2 → cylon_tpu; "from ..ops import join" is level 2 + "ops"
-    base = ["cylon_tpu", "plan"]
-    anchor = base[: max(len(base) - (level - 1), 0)]
-    full = ".".join(anchor + ([modname] if modname else []))
-    return any(full == f or full.startswith(f + ".")
-               for f in FORBIDDEN)
+sys.path.insert(0, REPO)
 
 
 def check() -> int:
-    bad = []
-    for entry in sorted(os.listdir(PLAN_DIR)):
-        if not entry.endswith(".py"):
-            continue
-        path = os.path.join(PLAN_DIR, entry)
-        tree = ast.parse(open(path).read(), filename=path)
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                for alias in node.names:
-                    if _is_forbidden(alias.name, 0, entry):
-                        bad.append((entry, node.lineno, alias.name))
-            elif isinstance(node, ast.ImportFrom):
-                mod = node.module or ""
-                if _is_forbidden(mod, node.level, entry):
-                    bad.append((entry, node.lineno,
-                                "." * node.level + mod))
+    from cylon_tpu.analysis import AnalysisContext, run_checkers
+
+    ctx = AnalysisContext(os.path.join(REPO, "cylon_tpu"))
+    res = run_checkers(ctx, families=["layering"])
+    bad = [f for f in res.findings if f.rule == "layering/plan-no-ops"]
     if bad:
         print("plan-import lint: cylon_tpu/plan must go through "
               "dist_ops/table_api, never ops/ kernels:", file=sys.stderr)
-        for fname, line, mod in bad:
-            print(f"  cylon_tpu/plan/{fname}:{line}: imports {mod}",
+        for f in bad:
+            print(f"  cylon_tpu/{f.path}:{f.line}: {f.message}",
                   file=sys.stderr)
         return 1
     print("plan-import lint: OK")

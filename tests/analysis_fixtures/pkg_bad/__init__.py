@@ -1,0 +1,1 @@
+"""Fixture package root (parsed by the analysis suite, never imported)."""

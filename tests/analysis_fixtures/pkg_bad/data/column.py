@@ -1,0 +1,11 @@
+"""Fixture data/ storage module reaching into kernels (table.py is the
+only sanctioned facade)."""
+from ..ops import bad_kernel  # SEEDED: layering/data-below-ops
+
+# suppression demo: the same violation on the next line is silenced and
+# must count as suppressed, not as a finding
+from ..ops import bad_kernel as bk2  # cylint: disable=layering/data-below-ops
+
+
+def storage():
+    return bad_kernel, bk2

@@ -1,0 +1,31 @@
+"""Fixture ops/ module: a layering violation (kernels importing the
+distribution layer) plus host syncs inside traced code."""
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from ..parallel import shard  # SEEDED: layering/ops-leaf
+
+
+def _kernel(x):
+    n = int(x.sum())               # SEEDED: hostsync/concretize
+    h = np.asarray(x)              # SEEDED: hostsync/transfer
+    return jnp.zeros(4) + n + h.shape[0]
+
+
+bad_fn = jax.jit(_kernel)
+
+
+def _helper(y):
+    return jax.device_get(y)       # SEEDED: hostsync/transfer (via closure)
+
+
+@jax.jit
+def decorated_kernel(y):
+    v = y.item()                   # SEEDED: hostsync/transfer
+    return _helper(y) + v
+
+
+def host_side_ok(y):
+    # NOT traced: host transfers here are legal and must not be flagged
+    return np.asarray(jax.device_get(y)).item()

@@ -1,0 +1,8 @@
+"""Fixture module reaching into telemetry's span internals."""
+from . import telemetry
+from .telemetry import _collectors  # SEEDED: layering/private-internals
+
+
+def leak():
+    # SEEDED: layering/private-internals (attribute access form)
+    return telemetry._collectors + _collectors

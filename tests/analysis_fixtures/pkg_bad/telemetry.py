@@ -1,0 +1,9 @@
+"""Fixture base-layer module that illegally imports back into the
+package (base-leaf contract)."""
+from . import sneaky  # SEEDED: layering/base-leaf
+
+_collectors = []
+
+
+def phase(name):
+    return name

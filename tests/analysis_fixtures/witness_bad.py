@@ -1,0 +1,26 @@
+"""Seeded plan corpus for the witness checker: an optimized join plan
+whose left-side Shuffle was deleted BY HAND with no witness to justify
+it (must be rejected), next to the intact optimization of the same
+logical plan (must verify clean). Loaded via --witness-plan-module."""
+from cylon_tpu.analysis.witness import _scan, mutate_delete_shuffle
+from cylon_tpu.plan import ir
+from cylon_tpu.plan.optimizer import optimize
+
+WORLD = 4
+
+
+def _logical():
+    left = _scan(["int32", "float32"], world=WORLD)
+    right = _scan(["int32", "int32"], world=WORLD, name="r")
+    return ir.GroupBy(ir.Join(left, right, [0], [0]), [0], [3], ["sum"])
+
+
+def build_plans():
+    intact, _stats = optimize(_logical(), WORLD)
+    mutated, _stats = optimize(_logical(), WORLD)
+    assert mutate_delete_shuffle(mutated, world=WORLD), \
+        "fixture plan lost its mutation site"
+    return [
+        ("intact-join-groupby", intact, WORLD, True),
+        ("hand-deleted-shuffle", mutated, WORLD, False),
+    ]

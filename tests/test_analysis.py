@@ -1,0 +1,209 @@
+"""cylon_tpu.analysis self-tests: each checker reports EXACTLY the
+violations seeded in tests/analysis_fixtures/ (no more, no fewer), the
+repo's own tree is clean, suppressions count, and the JSON output
+schema is stable."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import cylon_tpu
+from cylon_tpu.analysis import (AnalysisContext, SCHEMA_VERSION,
+                                run_checkers, to_json_text)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "analysis_fixtures")
+PKG_BAD = os.path.join(FIXTURES, "pkg_bad")
+PKG_REAL = os.path.dirname(os.path.abspath(cylon_tpu.__file__))
+
+
+def findings_of(res, family):
+    return [f for f in res.findings if f.family == family]
+
+
+# ---------------------------------------------------------------------------
+# layering
+# ---------------------------------------------------------------------------
+
+
+def test_layering_fixture_reports_exactly_seeded():
+    res = run_checkers(AnalysisContext(PKG_BAD), families=["layering"])
+    got = {(f.path, f.line, f.rule) for f in res.findings}
+    assert got == {
+        ("telemetry.py", 3, "layering/base-leaf"),
+        ("sneaky.py", 3, "layering/private-internals"),
+        ("sneaky.py", 8, "layering/private-internals"),
+        ("ops/bad_kernel.py", 7, "layering/ops-leaf"),
+        ("plan/bad_lowering.py", 3, "layering/plan-no-ops"),
+        ("plan/bad_lowering.py", 4, "layering/plan-no-ops"),
+        ("data/column.py", 3, "layering/data-below-ops"),
+    }, res.format_text()
+    # the seeded suppression on data/column.py:7 counted as suppressed
+    assert res.suppressed == 1
+
+
+def test_layering_real_tree_clean():
+    res = run_checkers(AnalysisContext(PKG_REAL), families=["layering"])
+    assert res.findings == [], res.format_text()
+
+
+def test_plan_imports_shim_delegates():
+    r = subprocess.run(
+        [sys.executable, os.path.join(PKG_REAL, "..", "scripts",
+                                      "check_plan_imports.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "plan-import lint: OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# hostsync
+# ---------------------------------------------------------------------------
+
+
+def test_hostsync_fixture_reports_exactly_seeded():
+    res = run_checkers(AnalysisContext(PKG_BAD), families=["hostsync"])
+    got = {(f.path, f.line, f.rule) for f in res.findings}
+    assert got == {
+        ("ops/bad_kernel.py", 11, "hostsync/concretize"),
+        ("ops/bad_kernel.py", 12, "hostsync/transfer"),
+        ("ops/bad_kernel.py", 20, "hostsync/transfer"),
+        ("ops/bad_kernel.py", 25, "hostsync/transfer"),
+    }, res.format_text()
+    # host_side_ok's transfers are OUTSIDE any traced closure: none of
+    # its lines (29+) may appear
+    assert not any(f.line >= 28 for f in res.findings)
+
+
+def test_hostsync_real_tree_clean():
+    res = run_checkers(AnalysisContext(PKG_REAL), families=["hostsync"])
+    assert res.findings == [], res.format_text()
+
+
+def test_hostsync_closure_reports_trace_chain():
+    res = run_checkers(AnalysisContext(PKG_BAD), families=["hostsync"])
+    via = [f.message for f in res.findings if f.line == 20]
+    assert via and "decorated_kernel" in via[0] and "_helper" in via[0]
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+
+def test_collectives_fixture_reports_exactly_seeded():
+    ctx = AnalysisContext(PKG_REAL, options={
+        "collectives_entry_module":
+            os.path.join(FIXTURES, "collectives_bad.py")})
+    res = run_checkers(ctx, families=["collectives"])
+    rules = sorted(f.rule for f in res.findings)
+    assert rules == ["collectives/all-to-all-axes",
+                     "collectives/f64-promotion",
+                     "collectives/trace-error"], res.format_text()
+    by_rule = {f.rule: f.message for f in res.findings}
+    assert "bad_axis" in by_rule["collectives/trace-error"]
+    assert "bad_all_to_all" in by_rule["collectives/all-to-all-axes"]
+    assert "f64_promotion" in by_rule["collectives/f64-promotion"]
+    # the clean control kernel contributed nothing
+    assert not any("clean" in f.message for f in res.findings)
+
+
+def test_collectives_real_catalog_clean():
+    res = run_checkers(AnalysisContext(PKG_REAL),
+                       families=["collectives"])
+    assert res.findings == [], res.format_text()
+    # Pallas stream factories are skipped off-TPU, with a note
+    assert any("TPU-only" in n for n in res.notes)
+
+
+# ---------------------------------------------------------------------------
+# witness (checker level; verifier semantics in test_plan_verify.py)
+# ---------------------------------------------------------------------------
+
+
+def test_witness_fixture_rejects_mutated_accepts_intact():
+    ctx = AnalysisContext(PKG_REAL, options={
+        "witness_plan_module": os.path.join(FIXTURES, "witness_bad.py")})
+    res = run_checkers(ctx, families=["witness"])
+    assert len(res.findings) == 1, res.format_text()
+    f = res.findings[0]
+    assert f.rule == "witness/unjustified-elision"
+    assert "hand-deleted-shuffle" in f.message
+    assert "intact" not in f.message
+
+
+def test_witness_default_corpus_clean():
+    res = run_checkers(
+        AnalysisContext(PKG_REAL, options={"random_plans": 32}),
+        families=["witness"])
+    assert res.findings == [], res.format_text()
+    assert any("mutations correctly rejected" in n for n in res.notes)
+
+
+# ---------------------------------------------------------------------------
+# output schema + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_json_schema_stable():
+    res = run_checkers(AnalysisContext(PKG_BAD), families=["layering"])
+    doc = json.loads(to_json_text(res))
+    assert set(doc) == {"version", "ok", "checkers", "counts",
+                        "suppressed", "notes", "findings"}
+    assert doc["version"] == SCHEMA_VERSION == 1
+    assert doc["ok"] is False
+    assert doc["checkers"] == ["layering"]
+    assert doc["counts"] == {"layering": 7}
+    assert doc["suppressed"] == 1
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message"}
+        assert isinstance(f["line"], int)
+    # deterministic ordering: sorted by (path, line, rule)
+    keys = [(f["path"], f["line"], f["rule"]) for f in doc["findings"]]
+    assert keys == sorted(keys)
+
+
+def test_cli_exit_codes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(PKG_REAL)
+    ok = subprocess.run(
+        [sys.executable, "-m", "cylon_tpu.analysis", "--families",
+         "layering,hostsync"],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=300)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(
+        [sys.executable, "-m", "cylon_tpu.analysis", "--package-root",
+         PKG_BAD],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=300)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "[layering/plan-no-ops]" in bad.stdout
+
+
+def test_unknown_family_is_an_error():
+    """A typo in --families must not become an exit-0 gate that ran
+    nothing."""
+    with pytest.raises(ValueError, match="layring"):
+        run_checkers(AnalysisContext(PKG_BAD), families=["layring"])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "cylon_tpu.analysis", "--families",
+         "layring"],
+        capture_output=True, text=True, cwd=os.path.dirname(PKG_REAL),
+        env=env, timeout=300)
+    assert r.returncode == 2
+    assert "unknown checker families" in r.stderr
+
+
+def test_suppression_file_level(tmp_path):
+    pkg = tmp_path / "pkg_sup"
+    (pkg / "plan").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "plan" / "__init__.py").write_text("")
+    (pkg / "plan" / "x.py").write_text(
+        "# cylint: disable-file=layering/plan-no-ops\n"
+        "from ..ops import join\n")
+    res = run_checkers(AnalysisContext(str(pkg)), families=["layering"])
+    assert res.findings == []
+    assert res.suppressed == 1

@@ -16,7 +16,8 @@ import pytest
 
 import cylon_tpu as ct
 from cylon_tpu.parallel import dist_ops, distribute, is_distributed_table
-from conftest import REFERENCE_DATA, assert_rows_equal
+from conftest import REFERENCE_DATA, assert_rows_equal, \
+    requires_reference_data
 
 INP = os.path.join(REFERENCE_DATA, "input")
 OUT = os.path.join(REFERENCE_DATA, "output")
@@ -52,6 +53,7 @@ def cmp_tables(dist_t, local_t, name):
 # golden fixtures (world=4, matching the reference's mpirun -np 4 cases)
 # ---------------------------------------------------------------------------
 
+@requires_reference_data
 def test_golden_distributed_join_inner(dist_ctx):
     t1 = read_all_ranks(dist_ctx, "csv1", 4)
     t2 = read_all_ranks(dist_ctx, "csv2", 4)
@@ -60,6 +62,7 @@ def test_golden_distributed_join_inner(dist_ctx):
                       msg="join_inner world=4")
 
 
+@requires_reference_data
 @pytest.mark.parametrize("op", ["union", "subtract", "intersect"])
 def test_golden_distributed_setops(dist_ctx, op):
     t1 = read_all_ranks(dist_ctx, "csv1", 4)
@@ -68,6 +71,7 @@ def test_golden_distributed_setops(dist_ctx, op):
     assert_rows_equal(got, golden_all_ranks(op, 4), msg=f"{op} world=4")
 
 
+@requires_reference_data
 @pytest.mark.parametrize("world", [2])
 def test_golden_distributed_join_world2(world):
     ctx = ct.CylonContext.InitDistributed(ct.TPUConfig(world_size=world))
