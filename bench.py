@@ -431,7 +431,12 @@ def bench_plan_pipeline(ctx, n_rows: int, iters: int) -> dict:
     metadata, aggregates the join output in place, and prunes unused
     payload columns before the exchange. Shuffle counts come from
     telemetry phase spans (every `shuffle.exchange*` program on the
-    clock), so the elision is recorded, not inferred."""
+    clock), so the elision is recorded, not inferred — and the
+    artifact carries the MEASUREMENT LAYER's own outputs instead of
+    hand-rolled dicts: the per-query EXPLAIN ANALYZE PlanReport
+    (per-node rows/bytes/ms, machine-comparable across rounds) and the
+    metrics-registry delta for the timed section (shuffle bytes, rows
+    exchanged, collective launches, jit factory builds)."""
     import cylon_tpu as ct
     from cylon_tpu import plan, telemetry
     from cylon_tpu.parallel import dist_ops
@@ -460,12 +465,34 @@ def bench_plan_pipeline(ctx, n_rows: int, iters: int) -> dict:
     def planned():
         _sync(pipe.execute())
 
+    def counters_now():
+        snap = telemetry.metrics_snapshot()
+        keep = ("cylon_shuffle_bytes_total", "cylon_rows_exchanged_total",
+                "cylon_collective_launches_total")
+        out = {k: snap.get(k, 0) for k in keep}
+        out["kernel_factory_builds"] = sum(
+            v for k, v in snap.items()
+            if k.startswith("cylon_kernel_factory_builds_total") and
+            isinstance(v, int))
+        return out
+
+    c0 = counters_now()
     with telemetry.collect_phases() as ce:
         eager_s = _time(eager, iters)
         eager_shuffles = ce.count("shuffle.exchange") // (iters + 1)
+    c1 = counters_now()
     with telemetry.collect_phases() as cp:
         plan_s = _time(planned, iters)
         plan_shuffles = cp.count("shuffle.exchange") // (iters + 1)
+    c2 = counters_now()
+
+    # one analyzed run per shape: the per-node EXPLAIN ANALYZE records
+    # (rows/bytes/ms + optimizer stats + global shuffle count)
+    pipe.execute(analyze=True)
+    plan_report = pipe.last_report.to_dict()
+    pipe.execute(optimize=False, analyze=True)
+    eager_report = pipe.last_report.to_dict()
+
     world = max(ctx.get_world_size(), 1)
     total = 2 * n_rows
     return {
@@ -477,6 +504,12 @@ def bench_plan_pipeline(ctx, n_rows: int, iters: int) -> dict:
         "speedup": round(eager_s / plan_s, 3) if plan_s else 0.0,
         "eager_rows_per_s_per_chip": total / eager_s / world,
         "plan_rows_per_s_per_chip": total / plan_s / world,
+        "plan_report": plan_report,
+        "eager_report": eager_report,
+        "metrics": {
+            "eager": {k: c1[k] - c0[k] for k in c0},
+            "planned": {k: c2[k] - c1[k] for k in c1},
+        },
     }
 
 
@@ -545,11 +578,18 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
             except Exception as e:  # pragma: no cover - defensive
                 suite[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
     rps = dist_res["rows_per_s_per_chip"]
+    # the full registry snapshot (counters + per-phase latency
+    # histograms + HBM gauges) rides the artifact — the machine-
+    # comparable perf trajectory across BENCH rounds
+    from cylon_tpu import telemetry as _telemetry
+
+    _telemetry.sample_memory(ctx.memory_pool)
     return {
         "metric": "dist_inner_join_rows_per_sec_per_chip",
         "value": round(rps, 1),
         "unit": "rows/s/chip",
         "vs_baseline": round(rps / _BASELINE_ROWS_PER_S, 3),
+        "telemetry": _telemetry.metrics_snapshot(),
         "detail": {
             "n_rows_per_side": n_rows,
             "world": ctx.get_world_size(),

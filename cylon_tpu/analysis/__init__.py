@@ -1,21 +1,26 @@
 """cylon_tpu.analysis — pluggable static-analysis suite.
 
-Four checker families guard the invariants the paper's *local kernel +
+Five checker families guard the invariants the paper's *local kernel +
 shuffle + local kernel* decomposition rests on (SURVEY §1), each
 registered in `core.CHECKERS` and runnable from one entry point:
 
-* ``layering``    — declarative per-subsystem import contracts
-                    (generalizes scripts/check_plan_imports.py);
-* ``hostsync``    — AST detector for host transfers inside traced
-                    (`jit`/`shard_map`/Pallas) code;
-* ``collectives`` — jaxpr-level checks over the `parallel/` kernel
-                    factories on a virtual mesh: collective axis names,
-                    all_to_all split/concat discipline, no implicit
-                    float64 promotion;
-* ``witness``     — optimizer-independent re-derivation of partitioning
-                    witnesses over optimized plans (wraps
-                    plan/verify.py): every shuffle elision must be
-                    justified or the plan is rejected.
+* ``layering``      — declarative per-subsystem import contracts
+                      (generalizes scripts/check_plan_imports.py);
+* ``hostsync``      — AST detector for host transfers inside traced
+                      (`jit`/`shard_map`/Pallas) code;
+* ``collectives``   — jaxpr-level checks over the `parallel/` kernel
+                      factories on a virtual mesh: collective axis
+                      names, all_to_all split/concat discipline, no
+                      implicit float64 promotion;
+* ``witness``       — optimizer-independent re-derivation of
+                      partitioning witnesses over optimized plans
+                      (wraps plan/verify.py): every shuffle elision
+                      must be justified or the plan is rejected;
+* ``span-coverage`` — every public ``distributed_*`` op and every
+                      executor lowering must run under a telemetry
+                      span (the observability layer's coverage
+                      contract — an unspanned operator is invisible
+                      to shuffle counting and EXPLAIN ANALYZE).
 
 Run ``python -m cylon_tpu.analysis`` (see ``--help``); wired into
 ``scripts/check.sh`` ahead of tier-1. Rule catalog, suppression syntax
@@ -31,6 +36,7 @@ from . import layering as _layering          # noqa: F401,E402
 from . import hostsync as _hostsync          # noqa: F401,E402
 from . import collectives as _collectives    # noqa: F401,E402
 from . import witness as _witness            # noqa: F401,E402
+from . import spancov as _spancov            # noqa: F401,E402
 
 __all__ = ["AnalysisContext", "CHECKERS", "Finding", "RunResult",
            "SCHEMA_VERSION", "register", "run_checkers", "to_json_text"]

@@ -81,7 +81,7 @@ def main(argv=None) -> int:
         # scanning a fixture/foreign tree: the semantic checkers
         # (collectives/witness) are about the REAL package's kernels
         # and optimizer — run only the file-scanning families
-        families = ["layering", "hostsync"]
+        families = ["layering", "hostsync", "span-coverage"]
 
     ctx = AnalysisContext(root, options)
     try:

@@ -34,24 +34,38 @@ class LayerContract:
     module names for file-scoped contracts. ``exempt`` lists filenames
     inside the scope that are deliberately outside the contract — each
     with a reason in the table below, because an undocumented exemption
-    is just a hole."""
+    is just a hole. ``allow`` lists prefixes carved OUT of ``forbid``:
+    a leaf PACKAGE (telemetry/) forbids everything but must still
+    import its own submodules."""
 
     name: str
     scope: Tuple[str, ...]
     forbid: Tuple[str, ...]
     reason: str
     exempt: Tuple[str, ...] = ()
+    allow: Tuple[str, ...] = ()
 
 
 # The cylon_tpu layer map. Order: kernels at the bottom, facades above.
 DEFAULT_CONTRACTS: Tuple[LayerContract, ...] = (
     LayerContract(
         name="base-leaf",
-        scope=("status.py", "dtypes.py", "util.py", "telemetry.py",
-               "native.py", "memory.py"),
+        scope=("status.py", "dtypes.py", "util.py", "native.py",
+               "memory.py"),
         forbid=("",),  # any intra-package import
         reason="base-layer modules are leaves: everything imports them, "
                "so any import back into the package is a cycle seed",
+    ),
+    LayerContract(
+        name="telemetry-leaf",
+        scope=("telemetry",),
+        forbid=("",),            # any intra-package import...
+        allow=("telemetry",),    # ...except telemetry's own submodules
+        reason="telemetry is a base-layer LEAF grown into a package "
+               "(spans/metrics/export): everything instruments through "
+               "it, so any import back into the package is a cycle "
+               "seed — gauges sample MemoryPool duck-typed, never by "
+               "importing memory.py",
     ),
     LayerContract(
         name="ops-leaf",
@@ -110,9 +124,22 @@ DEFAULT_CONTRACTS: Tuple[LayerContract, ...] = (
 
 # Modules whose UNDERSCORE names are private to the module: importing or
 # attribute-accessing them from elsewhere is a finding. telemetry's span
-# internals (_collectors and friends) are the motivating case — a second
-# writer would race the identity-keyed unregistration discipline.
+# internals (_collectors, _sinks, _current) are the motivating case — a
+# second writer would race the identity-keyed unregistration discipline.
+# Matching is by PREFIX: after the module→package split, "telemetry"
+# covers telemetry.spans / telemetry.metrics / telemetry.export too
+# (and any future submodule), and every file under telemetry/ is an
+# owner allowed to touch its siblings' internals.
 PRIVATE_MODULES: Tuple[str, ...] = ("telemetry",)
+
+
+def _is_private_target(target: str, private_modules) -> Optional[str]:
+    """The owning private module when ``target`` is one (or a submodule
+    of one), else None."""
+    for pm in private_modules:
+        if target == pm or target.startswith(pm + "."):
+            return pm
+    return None
 
 
 def _matches(target: str, prefix: str) -> bool:
@@ -155,7 +182,8 @@ def check_layering(ctx: AnalysisContext) -> List[Finding]:
         mod = ctx.module_name(f)
         importer_pkg = importer_package(f.rel, ctx.module_name(f))
         active = _contract_for(f.rel, contracts)
-        is_private_owner = mod in private_modules
+        is_private_owner = _is_private_target(mod, private_modules) \
+            is not None
 
         for lineno, module, level, names in _iter_imports(f.tree):
             target = resolve_import(module, level, importer_pkg, package)
@@ -167,7 +195,8 @@ def check_layering(ctx: AnalysisContext) -> List[Finding]:
                 (target + "." + n) if target else n for n in names]
             for c in active:
                 hits = [t for t in sub_targets
-                        if any(_matches(t, p) for p in c.forbid)]
+                        if any(_matches(t, p) for p in c.forbid)
+                        and not any(_matches(t, a) for a in c.allow)]
                 if hits:
                     hit = max(hits, key=len)  # most specific module
                     dotted = f"{package}.{hit}" if hit else package
@@ -175,18 +204,19 @@ def check_layering(ctx: AnalysisContext) -> List[Finding]:
                         rule=f"layering/{c.name}", path=f.rel, line=lineno,
                         message=f"imports {dotted}: {c.reason}"))
                     break
-            # private-name imports from privacy-owning modules
-            for pm in private_modules:
-                if target == pm and not is_private_owner:
-                    for n in names:
-                        if n.startswith("_"):
-                            findings.append(Finding(
-                                rule="layering/private-internals",
-                                path=f.rel, line=lineno,
-                                message=f"imports private name "
-                                        f"{package}.{pm}.{n}: only "
-                                        f"{pm}.py may touch its "
-                                        f"internals"))
+            # private-name imports from privacy-owning modules (or any
+            # of their submodules, post package split)
+            pm = _is_private_target(target, private_modules)
+            if pm is not None and not is_private_owner:
+                for n in names:
+                    if n.startswith("_"):
+                        findings.append(Finding(
+                            rule="layering/private-internals",
+                            path=f.rel, line=lineno,
+                            message=f"imports private name "
+                                    f"{package}.{target}.{n}: only "
+                                    f"{pm}'s own modules may touch "
+                                    f"its internals"))
 
         if not is_private_owner:
             findings.extend(_private_attr_access(ctx, f, private_modules))
@@ -196,8 +226,9 @@ def check_layering(ctx: AnalysisContext) -> List[Finding]:
 def _private_attr_access(ctx: AnalysisContext, f, private_modules
                          ) -> List[Finding]:
     """Flag ``telemetry._collectors``-style attribute reads: find names
-    bound to a privacy-owning module by import, then any ``name._attr``
-    access on them."""
+    bound to a privacy-owning module (or any of its submodules — the
+    package form, ``telemetry.spans._collectors``) by import, then any
+    ``name._attr`` access on them."""
     package = ctx.package_name
     importer_pkg = importer_package(f.rel, ctx.module_name(f))
     bound = {}  # local name -> package-relative module path
@@ -206,7 +237,8 @@ def _private_attr_access(ctx: AnalysisContext, f, private_modules
             for alias in node.names:
                 target = resolve_import(alias.name, 0, importer_pkg,
                                          package)
-                if target in private_modules:
+                if target is not None and \
+                        _is_private_target(target, private_modules):
                     bound[alias.asname or alias.name.split(".")[-1]] = target
         elif isinstance(node, ast.ImportFrom):
             target = resolve_import(node.module or "", node.level,
@@ -215,7 +247,7 @@ def _private_attr_access(ctx: AnalysisContext, f, private_modules
                 continue
             for alias in node.names:
                 sub = (target + "." + alias.name) if target else alias.name
-                if sub in private_modules:
+                if _is_private_target(sub, private_modules):
                     bound[alias.asname or alias.name] = sub
     if not bound:
         return []
@@ -224,10 +256,11 @@ def _private_attr_access(ctx: AnalysisContext, f, private_modules
         if isinstance(node, ast.Attribute) and \
                 isinstance(node.value, ast.Name) and \
                 node.value.id in bound and node.attr.startswith("_"):
-            pm = bound[node.value.id]
+            mod = bound[node.value.id]
+            pm = _is_private_target(mod, private_modules)
             out.append(Finding(
                 rule="layering/private-internals", path=f.rel,
                 line=node.lineno,
-                message=f"touches {package}.{pm}.{node.attr}: only "
-                        f"{pm}.py may touch its internals"))
+                message=f"touches {package}.{mod}.{node.attr}: only "
+                        f"{pm}'s own modules may touch its internals"))
     return out

@@ -117,6 +117,26 @@ class Table:
         """Physical (padded) row slots."""
         return len(self._columns[0]) if self._columns else 0
 
+    @property
+    def nbytes(self) -> int:
+        """Device bytes this table's buffers span (data + validity +
+        varbytes words/starts + row mask) — shape × itemsize, computed
+        on the host with NO device sync. The telemetry layer's
+        ``bytes`` measurement for EXPLAIN ANALYZE reports."""
+        def _nb(arr) -> int:
+            return int(np.dtype(arr.dtype).itemsize) * \
+                int(np.prod(arr.shape))
+
+        total = 0 if self.row_mask is None else _nb(self.row_mask)
+        for c in self._columns:
+            total += _nb(c.data)
+            if c.validity is not None:
+                total += _nb(c.validity)
+            if c.is_varbytes:
+                vb = c.varbytes
+                total += _nb(vb.words) + _nb(vb.starts)
+        return total
+
     def emit_mask(self) -> jnp.ndarray:
         if self.row_mask is None:
             return jnp.ones(self.capacity, dtype=bool)

@@ -22,7 +22,6 @@ gathered to the host.
 """
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -47,7 +46,8 @@ from ..ops import join as _join
 from ..ops import order as _order
 from ..ops import setops as _setops
 from ..status import Code, CylonError
-from ..telemetry import phase as _phase
+from ..telemetry import annotate as _annotate, counted_cache, \
+    phase as _phase, span as _span
 from . import shard
 from ..util import capacity as _capacity
 from .shuffle import count_pair, exchange, exchange_pair, \
@@ -77,7 +77,7 @@ def _table_payload(t: Table) -> dict:
 # ---------------------------------------------------------------------------
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _string_hash_fn(mesh, max_words: int):
     """Per-shard content hashes (h1, h2, h3, len-as-u32) for a sharded
     varbytes column — strings._hash_rows under shard_map (shard-relative
@@ -102,7 +102,7 @@ def _dist_string_keys(ctx: CylonContext, col: Column):
         shard.pin(vb.lengths, ctx))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _word_lanes_fn(mesh, k_lim: int):
     """Per-shard word-lane lift of a sharded varbytes column
     (shard-relative starts make each shard's gather self-contained —
@@ -211,7 +211,7 @@ def _partition_targets_dist(ctx: CylonContext, cols: Sequence[Column],
     return _targets_from_hashes(ctx, h1s)
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _word_targets_fn(mesh):
     """Word-level (targets, emit) from row-level (targets, emit): every
     word inherits its row's shuffle target; words of dead rows and slack
@@ -232,7 +232,7 @@ def _word_targets_fn(mesh):
                              out_specs=spec))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _starts_reconcile_fn(mesh, row_block: int, word_block: int):
     """Rebuild shard-relative varbytes starts after a row+word exchange
     pair, for ANY combination of padded/compact layouts (block=0 means
@@ -289,7 +289,7 @@ def _exchange_varbytes_words(ctx: CylonContext, vb, targets, emit,
                                 int(wout["w"].shape[0]) // world))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _lanes_interleave_fn(mesh, K: int):
     """Per-shard (lengths, lanes…) → (interleaved words, shard-relative
     starts): the strided-layout assembly stays local to each shard (a
@@ -412,7 +412,7 @@ def _exchange_table_pair(t1: Table, tg1, e1, c1, t2: Table, tg2, e2, c2,
 # -- per-shard varlen gather (count → take at worst-shard capacity) --
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _varlen_count_fn(mesh, replicated: bool = False):
     """Output-word count for a per-shard varlen gather. ``replicated``:
     the length source is a replicated (vocab) array, idx stays sharded."""
@@ -430,7 +430,7 @@ def _varlen_count_fn(mesh, replicated: bool = False):
                              out_specs=P()))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _varlen_take_fn(mesh, cap_w: int, replicated: bool = False):
     """Per-shard varlen gather (strings._take_program under shard_map).
     ``replicated``: gather FROM a replicated source (dict vocab lift)."""
@@ -542,7 +542,7 @@ def _all_valid(cols: Sequence[Column]) -> jnp.ndarray:
 # per-shard kernels (cached per mesh/static-shape signature)
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _join_plan_fn(mesh, join_type: _join.JoinType):
     """Per-shard join plan: ONE fused sort per shard (join_plan_keys);
     match arrays stay sharded on device for the materialize phase, the
@@ -565,7 +565,7 @@ def _join_plan_fn(mesh, join_type: _join.JoinType):
 _gather_side = _join.gather_columns
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _join_plan_stream_fn(mesh, join_type: _join.JoinType, nk: int,
                          a_desc, b_desc, block_rows: int, hash_mode: bool):
     """Per-shard Pallas streaming join plan under shard_map — the same
@@ -593,7 +593,7 @@ def _join_plan_stream_fn(mesh, join_type: _join.JoinType, nk: int,
                              check_vma=False))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _join_mat_stream_fn(mesh, join_type: _join.JoinType, cap_e: int,
                         a_desc, b_desc, block_rows: int):
     spec = P(mesh.axis_names[0])
@@ -629,7 +629,7 @@ def _dist_stream_mode(lkb, rkb, join_type: _join.JoinType, world: int):
     return None
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _join_mat_fn(mesh, join_type: _join.JoinType, cap_p: int, cap_u: int):
     spec = P(mesh.axis_names[0])
 
@@ -644,7 +644,7 @@ def _join_mat_fn(mesh, join_type: _join.JoinType, cap_p: int, cap_u: int):
                              out_specs=spec))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _setop_count_fn(mesh):
     spec = P(mesh.axis_names[0])
 
@@ -660,7 +660,7 @@ def _setop_count_fn(mesh):
                              out_specs=P()))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _setop_mat_fn(mesh, op: _setops.SetOp, cap: int):
     spec = P(mesh.axis_names[0])
 
@@ -678,7 +678,7 @@ def _setop_mat_fn(mesh, op: _setops.SetOp, cap: int):
                              out_specs=spec))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _varlen_take_concat_count_fn(mesh):
     """Word count for a gather over the per-shard concat [left; right]
     varbytes pair."""
@@ -696,7 +696,7 @@ def _varlen_take_concat_count_fn(mesh):
                              out_specs=P()))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _varlen_take_concat_fn(mesh, cap_w: int):
     """Varlen gather over the per-shard concat of two varbytes columns.
     The source concat needs NO repacking: right starts shift by the
@@ -716,7 +716,7 @@ def _varlen_take_concat_fn(mesh, cap_w: int):
                              out_specs=spec))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _groupby_fn(mesh, ops: Tuple[_groupby.AggregationOp, ...],
                 col_ids: Tuple[int, ...], all_valid: Tuple[bool, ...]):
     spec = P(mesh.axis_names[0])
@@ -914,7 +914,8 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
 
     seq = ctx.get_next_sequence()
     shuffled = []
-    with _phase("distributed_join.shuffle", seq):
+    with _span("distributed_join.shuffle", seq, world=world,
+               rows_in=left_d.capacity + right_d.capacity) as _sp:
         plan = []
         for t, kcols, kidx, other in ((left_d, lcols, lidx, rcols),
                                       (right_d, rcols, ridx, lcols)):
@@ -939,6 +940,7 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
         # charges ~100 ms per round trip, so fusing halves the fixed
         # cost of the composition)
         ex = [p for p in plan if p[0] == "exchange"]
+        _sp.set(sides_exchanged=len(ex), sides_skipped=2 - len(ex))
         results = {}
         if len(ex) == 2:
             # 1-wide mesh + dense emits: skip the count sync entirely —
@@ -1021,11 +1023,13 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
             # [n_primary, n_unmatched_b]; capacity = worst shard (all
             # shards share one program)
             counts = np.asarray(jax.device_get(counts2)).reshape(world, 2)
+            _annotate(rows_out=int(counts[:, 0].sum()))
         cap_p = _capacity(int(counts[:, 0].max()))
         cap_u = _capacity(int(counts[:, 1].max())) \
             if jt == _join.JoinType.FULL_OUTER else 0
 
-        with _phase("distributed_join.materialize", seq):
+        with _span("distributed_join.materialize", seq, world=world,
+                   capacity=cap_p + cap_u):
             lod, lov, rod, rov, emit, lidx_o, ridx_o = _join_mat_fn(
                 ctx.mesh, jt, cap_p, cap_u)(
                 lo, m, bperm, un_mask, aemit, ldat, lval, rdat, rval)
@@ -1172,7 +1176,7 @@ def _varying(axis, tree):
     return tree  # old jax: no varying-mesh-axes checker to satisfy
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _ring_count_fn(mesh, emit_unmatched_a: bool, nkeys: int):
     axis = mesh.axis_names[0]
     world = mesh.devices.size
@@ -1204,7 +1208,7 @@ def _ring_count_fn(mesh, emit_unmatched_a: bool, nkeys: int):
                              out_specs=P()))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _ring_mat_fn(mesh, emit_unmatched_a: bool, cap_step: int, cap_extra: int,
                  nkeys: int):
     axis = mesh.axis_names[0]
@@ -1453,7 +1457,9 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp,
 
     seq = ctx.get_next_sequence()
     shuffled = []
-    with _phase("distributed_set_op.shuffle", seq):
+    with _span("distributed_set_op.shuffle", seq, world=world,
+               rows_in=left_d.capacity + right_d.capacity,
+               op=str(op)):
         # exchange ONLY the aligned columns; key bits (word lanes /
         # hash quads / ordered bits) and validity key lanes are
         # recomputed per shard from the shuffled columns — the exchange
@@ -1565,8 +1571,11 @@ def _groupby_shuffle_agg(ctx: CylonContext, key_columns, value_columns,
     if skip_exchange:
         out_cols = list(key_columns) + list(value_columns)
         emit_s = emit
+        _annotate(exchange_skipped=True)
     else:
-        with _phase("distributed_groupby.shuffle", seq):
+        with _span("distributed_groupby.shuffle", seq,
+                   world=ctx.get_world_size(),
+                   rows_in=int(emit.shape[0])):
             view = Table(list(key_columns) + list(value_columns), ctx,
                          None)
             targets = shard.pin(
@@ -1772,7 +1781,7 @@ SORT_SAMPLES_PER_SHARD = 4096
 RING_SKEW_FACTOR = 4
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _shard_sort_fn(mesh, nd: int, nv: int, nk: int = 1):
     """Per-shard fused sort by (dead-last, key lanes…): every payload
     column rides as a sort operand; returns sorted dat/val/emit plus the
@@ -1901,7 +1910,8 @@ def distributed_sort(table: Table, order_by, ascending=True,
     lanes = [l for col_lanes in per_col for l in col_lanes]
 
     seq = ctx.get_next_sequence()
-    with _phase("distributed_sort.partition", seq):
+    with _span("distributed_sort.partition", seq, world=world,
+               rows_in=t.capacity):
         lanes = [shard.pin(l, ctx) for l in lanes]
         emit = shard.pin(t.emit_mask(), ctx)
         # splitter memoization (the count-cache pattern, weakref-keyed

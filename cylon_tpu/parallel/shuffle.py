@@ -34,7 +34,6 @@ transit — the shuffle doubles as a compaction step.
 """
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -48,7 +47,8 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from ..context import CylonContext
-from ..telemetry import phase as _phase
+from ..telemetry import counted_cache, counter as _counter, \
+    phase as _phase, span as _span
 from ..util import pow2 as _pow2
 
 # Upper bound on the per-round block (rows per (src,dst) pair per round).
@@ -76,6 +76,22 @@ def replicated_gather(x, axis: str, world: int):
     return jax.lax.psum(mat, axis)
 
 
+def _payload_nbytes(payload) -> int:
+    """Host-computable byte size of a payload pytree (shape × itemsize;
+    no device sync) — the ``bytes_moved`` span attribute and the
+    ``cylon_shuffle_bytes_total`` counter feed."""
+    return sum(int(np.dtype(x.dtype).itemsize) * int(np.prod(x.shape))
+               for x in jax.tree.leaves(payload))
+
+
+def _record_exchange(rows: int, nbytes: int, programs: int = 1) -> None:
+    """Metrics for one exchange dispatch: payload bytes through the
+    collective, live rows moved, compiled-program launches."""
+    _counter("cylon_shuffle_bytes_total").inc(nbytes)
+    _counter("cylon_rows_exchanged_total").inc(rows)
+    _counter("cylon_collective_launches_total").inc(programs)
+
+
 # beyond this world size, per-target compare-sum passes cost more than
 # one scatter-class segment_sum
 _COUNT_COMPARE_MAX_W = 64
@@ -92,7 +108,7 @@ def _target_counts(t, world):
                                num_segments=world + 1)[:world]
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _count_fn(mesh):
     """Send-count matrix counts[s, t] = live rows shard s sends to shard t,
     REPLICATED on every shard (an in-program all_gather) so the host fetch
@@ -237,7 +253,7 @@ def _padded_body(axis, world, block, payload, targets, emit):
     return outs, new_emit, counts_in
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _exchange_padded_fn(mesh, block: int):
     """Scatter-free single-shot exchange: every (src,dst) pair moves ONE
     [block] slice and lands at the STATIC slot dst_out[src*block:...] —
@@ -255,7 +271,7 @@ def _exchange_padded_fn(mesh, block: int):
                              out_specs=spec))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _exchange_padded_pair_fn(mesh, block1: int, block2: int):
     """BOTH sides of a two-table shuffle in ONE compiled program — one
     dispatch instead of two, and XLA schedules the two bucket sorts and
@@ -293,9 +309,13 @@ def exchange_pair(payload1, targets1, emit1, counts1,
         mb2 = _budget_block_cap(payload2, 1, budget, b2, 8)
         if b1 <= mb1 and b2 <= mb2:
             seq = ctx.get_next_sequence()
-            with _phase("shuffle.exchange_pair", seq):
+            rows = int(targets1.shape[0]) + int(targets2.shape[0])
+            nbytes = _payload_nbytes(payload1) + _payload_nbytes(payload2)
+            with _span("shuffle.exchange_pair", seq, world=1,
+                       mode="padded", rows=rows, bytes_moved=nbytes):
                 res = _exchange_padded_pair_fn(ctx.mesh, b1, b2)(
                     payload1, targets1, emit1, payload2, targets2, emit2)
+            _record_exchange(rows, nbytes)
             out1, emit1_o, ci1, out2, emit2_o, ci2 = res
             return ((out1, emit1_o, b1,
                      {"mode": "padded", "block": b1, "counts_in": ci1}),
@@ -310,9 +330,14 @@ def exchange_pair(payload1, targets1, emit1, counts1,
                                   buffer_factor=8)
     if ok1 and ok2:
         seq = ctx.get_next_sequence()
-        with _phase("shuffle.exchange_pair", seq):
+        rows = (int(counts1.sum()) if counts1 is not None else 0) \
+            + (int(counts2.sum()) if counts2 is not None else 0)
+        nbytes = _payload_nbytes(payload1) + _payload_nbytes(payload2)
+        with _span("shuffle.exchange_pair", seq, world=world,
+                   mode="padded", rows=rows, bytes_moved=nbytes):
             res = _exchange_padded_pair_fn(ctx.mesh, b1, b2)(
                 payload1, targets1, emit1, payload2, targets2, emit2)
+        _record_exchange(rows, nbytes)
         out1, emit1_o, ci1, out2, emit2_o, ci2 = res
         return ((out1, emit1_o, world * b1,
                  {"mode": "padded", "block": b1, "counts_in": ci1}),
@@ -322,7 +347,7 @@ def exchange_pair(payload1, targets1, emit1, counts1,
             exchange(payload2, targets2, emit2, ctx, counts=counts2))
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _exchange_fn(mesh, block: int, rounds: int, cap_out: int):
     """The blockwise body phase (skew fallback): K rounds, each moving
     one [W,B] block per leaf and compacting received rows at running
@@ -385,7 +410,7 @@ def _exchange_fn(mesh, block: int, rounds: int, cap_out: int):
 PADDED_WASTE_FACTOR = 2
 
 
-@lru_cache(maxsize=None)
+@counted_cache
 def _count2_fn(mesh):
     """Both sides' send-count matrices in ONE compiled program (one
     host sync for a two-table shuffle instead of two — the axon tunnel
@@ -441,9 +466,11 @@ def count_pair(targets1, emit1, targets2, emit2, ctx: CylonContext):
     Feed the results to exchange(..., counts=...)."""
     def compute():
         # result is [src, 2, dst] (replicated_gather stacks per source)
-        with _phase("shuffle.count", ctx.get_next_sequence()):
+        with _span("shuffle.count", ctx.get_next_sequence(),
+                   world=ctx.get_world_size(), tables=2):
             both = np.asarray(jax.device_get(
                 _count2_fn(ctx.mesh)(targets1, emit1, targets2, emit2)))
+        _counter("cylon_collective_launches_total").inc()
         return both[:, 0, :], both[:, 1, :]
 
     return _count_cached(
@@ -526,16 +553,22 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
         mb1 = _budget_block_cap(payload, 1, budget0, block1
                                 if max_block is None else max_block, 4)
         if block1 <= mb1:
-            with _phase("shuffle.exchange", seq):
+            rows = int(targets.shape[0])
+            nbytes = _payload_nbytes(payload)
+            with _span("shuffle.exchange", seq, world=1, mode="padded",
+                       rows=rows, bytes_moved=nbytes):
                 out, new_emit, counts_in = _exchange_padded_fn(
                     ctx.mesh, block1)(payload, targets, emit)
+            _record_exchange(rows, nbytes)
             return out, new_emit, block1, {
                 "mode": "padded", "block": block1, "counts_in": counts_in}
     if counts is None:
         def compute():
-            with _phase("shuffle.count", seq):
-                return np.asarray(jax.device_get(
+            with _span("shuffle.count", seq, world=world, tables=1):
+                res = np.asarray(jax.device_get(
                     _count_fn(ctx.mesh)(targets, emit)))
+            _counter("cylon_collective_launches_total").inc()
+            return res
 
         counts = _count_cached(
             ("one", id(ctx.mesh), id(targets), id(emit)),
@@ -548,16 +581,23 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
                                            max_block=max_block)
     cap_padded = world * block_p
     cap_compact = _pow2(recv_max)
-    with _phase("shuffle.exchange", seq):
+    rows_live = int(counts.sum()) if counts.size else 0
+    nbytes = _payload_nbytes(payload)
+    with _span("shuffle.exchange", seq, world=world,
+               mode="padded" if padded_ok else "compact",
+               rows=rows_live, bytes_moved=nbytes) as sp:
         if padded_ok:
             out, new_emit, counts_in = _exchange_padded_fn(
                 ctx.mesh, block_p)(payload, targets, emit)
+            _record_exchange(rows_live, nbytes)
             return out, new_emit, cap_padded, {
                 "mode": "padded", "block": block_p, "counts_in": counts_in}
         block = min(block_p, mb)
         # pow2 round count bounds the compile cache to O(log^3) programs
         rounds = _pow2(-(-max(max_pair, 1) // block))
+        sp.set(block=block, rounds=rounds)
         out, new_emit, counts_in = _exchange_fn(
             ctx.mesh, block, rounds, cap_compact)(payload, targets, emit)
+    _record_exchange(rows_live, nbytes)
     return out, new_emit, cap_compact, {
         "mode": "compact", "block": 0, "counts_in": counts_in}

@@ -13,24 +13,29 @@ already hash-placed on the same keys, (2) prunes unreferenced columns
 below the exchanges, and (3) pushes filters below shuffles so dead rows
 drop in transit; the executor lowers the optimized plan onto the
 existing `dist_ops`/`table_api` primitives (never `ops/` kernels — see
-scripts/check_plan_imports.py) and stamps per-node `telemetry.phase`
+scripts/check_plan_imports.py) and stamps per-node `telemetry.span`
 spans, so a plan's shuffle count is directly observable in logs and
-Perfetto traces as ``plan.shuffle.*`` labels.
+Perfetto traces as ``plan.shuffle.*`` labels. `LazyTable.explain(
+analyze=True)` executes the query under a recorder and renders the
+plan annotated with measured rows/bytes/ms per node (EXPLAIN ANALYZE
+— see `plan.report.PlanReport` and docs/telemetry.md).
 
 The retired `parallel/task_plan.py` task-routing overlay lives on as
 `plan.tasks` (same `LogicalTaskPlan`/`task_exchange` API).
 """
-from . import ir, optimizer, executor, tasks
+from . import ir, optimizer, executor, report, tasks
 from .ir import (Filter, GroupBy, Join, PlanNode, Project, Scan, SetOp,
                  Shuffle, Sort, col)
 from .lazy import LazyTable, scan
 from .optimizer import PlanStats, optimize
-from .executor import execute
+from .executor import execute, execute_analyzed
+from .report import NodeMeasure, PlanReport
 from .tasks import LogicalTaskPlan, task_exchange
 
 __all__ = [
     "Filter", "GroupBy", "Join", "LazyTable", "LogicalTaskPlan",
-    "PlanNode", "PlanStats", "Project", "Scan", "SetOp", "Shuffle",
-    "Sort", "col", "execute", "executor", "ir", "optimize", "optimizer",
-    "scan", "task_exchange", "tasks",
+    "NodeMeasure", "PlanNode", "PlanReport", "PlanStats", "Project",
+    "Scan", "SetOp", "Shuffle", "Sort", "col", "execute",
+    "execute_analyzed", "executor", "ir", "optimize", "optimizer",
+    "report", "scan", "task_exchange", "tasks",
 ]

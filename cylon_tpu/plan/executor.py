@@ -1,12 +1,21 @@
 """Plan executor: lowers an (optimized) logical plan onto `dist_ops`.
 
-Lowering discipline (enforced by scripts/check_plan_imports.py): the
-executor reaches device kernels ONLY through `parallel/dist_ops`,
-`data/table` methods, and `table_api` — never `ops/` directly. Every
-node executes inside a `telemetry.phase` span; nodes that perform an
-all-to-all exchange use ``plan.shuffle.<kind>`` labels, so a plan's
-real shuffle count is countable from the host log or a Perfetto trace
-(grep ``plan.shuffle``).
+Lowering discipline (enforced by the layering + span-coverage
+checkers): the executor reaches device kernels ONLY through
+`parallel/dist_ops`, `data/table` methods, and `table_api` — never
+`ops/` directly. Every node executes inside a `telemetry.span`; nodes
+that perform an all-to-all exchange use ``plan.shuffle.<kind>`` labels,
+so a plan's real shuffle count is countable from the host log, a
+Perfetto trace (grep ``plan.shuffle``), or `collect_phases`.
+
+Label honesty is RUNTIME-decided, in both directions: a join whose
+sides all arrive co-partitioned logs ``plan.join`` even when the plan
+kept Shuffle markers, and a join whose sides will exchange logs
+``plan.shuffle.join`` even when the plan carries no markers (an
+unoptimized plan still pays real exchanges — the label must say so).
+The same discipline as `GroupBy.local_ok`: plan metadata alone is
+never trusted for a correctness-bearing skip NOR for an observability
+claim; `_side_exchanges` mirrors `distributed_join`'s witness check.
 
 Shuffle markers below a `Join` are NOT executed standalone: they fold
 into `distributed_join`, whose fused two-table exchange runs both
@@ -14,21 +23,22 @@ sides in one compiled program (one count sync instead of two). A side
 whose marker was elided arrives co-partitioned and `distributed_join`
 skips it via the runtime witness.
 
-`GroupBy.local_ok` (set by the optimizer) is re-verified against the
-RUNTIME witness before the exchange is skipped — plan metadata alone
-is never trusted for a correctness-bearing skip; on mismatch the
-lowering falls back to the exchanging path (and honestly logs it as a
-shuffle).
+EXPLAIN ANALYZE: `execute_analyzed` wraps the run in a ``plan.query``
+root span and records per-node inclusive wall time, output rows/bytes
+and own telemetry labels into a `report.PlanReport`. The default
+`execute` path carries ZERO of this overhead (no recorder, no row-count
+syncs) — analysis is opt-in per query.
 """
 from __future__ import annotations
 
-from typing import Optional
+import time
+from typing import Optional, Tuple
 
-from .. import table_api
+from .. import table_api, telemetry
 from ..data import table as table_mod
 from ..data.table import Table
 from ..status import Code, CylonError
-from ..telemetry import phase as _phase
+from ..telemetry import span as _span
 from . import ir
 
 
@@ -43,16 +53,73 @@ def execute(plan: ir.PlanNode, ctx=None) -> Table:
     return _Exec(ctx).run(plan)
 
 
+def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None
+                     ) -> Tuple[Table, "object"]:
+    """Execute with per-node measurement; returns (Table, PlanReport).
+
+    The whole run nests under one ``plan.query`` span (the report's
+    span tree); HBM gauges are sampled from the context's MemoryPool
+    after the run, and the registry snapshot rides along so a BENCH
+    artifact is one ``report.to_dict()`` away."""
+    from .report import PlanReport, build_measures
+
+    with telemetry.collect_phases() as cp:
+        with _span("plan.query") as root_span:
+            ex = _Exec(ctx, recorder=_Recorder(cp.labels))
+            result = ex.run(plan)
+    pool = getattr(ex.ctx, "memory_pool", None) if ex.ctx is not None \
+        else None
+    memory = telemetry.sample_memory(pool) if pool is not None else {}
+    report = PlanReport(
+        root=build_measures(plan, ex._recorder.recs, cp.labels),
+        span=root_span,
+        shuffle_count=cp.count("plan.shuffle"),
+        total_ms=root_span.elapsed_ms,
+        world=_world(ex.ctx) if ex.ctx is not None else 1,
+        stats=stats, memory=memory,
+        metrics=telemetry.metrics_snapshot())
+    return result, report
+
+
+class _NodeRec:
+    """Raw per-node measurement (label-range indices into the query's
+    collect_phases stream + inclusive ms + output rows/bytes)."""
+
+    __slots__ = ("i0", "i1", "ms", "rows", "nbytes")
+
+
+class _Recorder:
+    def __init__(self, labels):
+        self._labels = labels     # live list of the query's collector
+        self.recs = {}            # id(plan node) -> _NodeRec
+
+    def run(self, node, fn):
+        rec = _NodeRec()
+        rec.i0 = len(self._labels)
+        t0 = time.perf_counter()
+        out = fn(node)
+        rec.ms = (time.perf_counter() - t0) * 1e3
+        rec.i1 = len(self._labels)
+        # row_count syncs ONE scalar per node — the analyze-mode cost
+        rec.rows = out.row_count
+        rec.nbytes = out.nbytes
+        self.recs[id(node)] = rec
+        return out
+
+
 class _Exec:
-    def __init__(self, ctx=None):
+    def __init__(self, ctx=None, recorder: Optional[_Recorder] = None):
         self.ctx = ctx
+        self._recorder = recorder
 
     def run(self, node: ir.PlanNode) -> Table:
         fn = getattr(self, f"_do_{node.kind}", None)
         if fn is None:
             raise CylonError(Code.NotImplemented,
                              f"no lowering for {type(node).__name__}")
-        return fn(node)
+        if self._recorder is None:
+            return fn(node)
+        return self._recorder.run(node, fn)
 
     def _seq(self) -> Optional[int]:
         return self.ctx.get_next_sequence() if self.ctx is not None else None
@@ -60,25 +127,54 @@ class _Exec:
     # -- leaves ---------------------------------------------------------
 
     def _do_scan(self, node: ir.Scan) -> Table:
-        t = node.table if node.table is not None \
-            else table_api.get_table(node.table_id)
-        if self.ctx is None:
-            self.ctx = t._ctx
+        with _span("plan.scan", self._seq()) as sp:
+            t = node.table if node.table is not None \
+                else table_api.get_table(node.table_id)
+            if self.ctx is None:
+                self.ctx = t._ctx
+            sp.set(rows_in=t.capacity, world=_world(self.ctx))
         return t
 
     # -- row/column ops -------------------------------------------------
 
     def _do_project(self, node: ir.Project) -> Table:
         t = self.run(node.children[0])
-        with _phase("plan.project", self._seq()):
+        with _span("plan.project", self._seq(), cols=len(node.cols),
+                   rows_in=t.capacity):
             return t.project(node.cols)
 
     def _do_filter(self, node: ir.Filter) -> Table:
         t = self.run(node.children[0])
-        with _phase("plan.filter", self._seq()):
+        with _span("plan.filter", self._seq(), rows_in=t.capacity):
             return t.filter_mask(node.expr.mask(t))
 
     # -- exchanges ------------------------------------------------------
+
+    def _side_exchanges(self, t: Table, keys, other: Table,
+                        other_keys) -> bool:
+        """True when `distributed_join` will exchange THIS side —
+        mirrors its runtime-witness skip check (signature over the
+        ALIGNED key columns vs the stored witness). A promoting
+        alignment only invalidates the side it actually promotes: a
+        side whose dtypes already equal the promoted common dtype
+        keeps its witness and is skipped, while the other side
+        exchanges (its aligned signature carries the promoted dtype
+        string the pre-alignment witness cannot match)."""
+        import jax.numpy as jnp
+
+        from ..parallel import shard
+
+        for k, ok in zip(keys, other_keys):
+            a, b = t._columns[k], other._columns[ok]
+            if a.is_string or b.is_string:
+                continue  # string keys: partition_signature is None below
+            common = jnp.promote_types(a.data.dtype, b.data.dtype)
+            if a.data.dtype != common:
+                return True
+        sig = shard.partition_signature(
+            [t._columns[k] for k in keys], tuple(keys),
+            self.ctx.get_world_size())
+        return sig is None or t._hash_partitioned != sig
 
     def _do_shuffle(self, node: ir.Shuffle) -> Table:
         from ..parallel import dist_ops, shard
@@ -93,7 +189,8 @@ class _Exec:
             self.ctx.get_world_size())
         if sig is not None and t._hash_partitioned == sig:
             return t
-        with _phase("plan.shuffle.explicit", self._seq()):
+        with _span("plan.shuffle.explicit", self._seq(),
+                   world=_world(self.ctx), rows_in=t.capacity):
             return dist_ops.shuffle(t, node.keys)
 
     def _do_join(self, node: ir.Join) -> Table:
@@ -102,12 +199,22 @@ class _Exec:
         # exchange machinery instead of running them standalone
         lsrc = l.children[0] if isinstance(l, ir.Shuffle) else l
         rsrc = r.children[0] if isinstance(r, ir.Shuffle) else r
-        n_ex = int(isinstance(l, ir.Shuffle)) + int(isinstance(r, ir.Shuffle))
         lt = self.run(lsrc)
         rt = self.run(rsrc)
-        label = "plan.shuffle.join" if n_ex and _world(self.ctx) > 1 \
-            else "plan.join"
-        with _phase(label, self._seq()):
+        world = _world(self.ctx)
+        # the label reports what the RUNTIME will do, not what the plan
+        # claims: count sides whose witness check will fail inside
+        # distributed_join (markers present or not)
+        n_ex = 0
+        if world > 1:
+            n_ex = int(self._side_exchanges(lt, node.left_on, rt,
+                                            node.right_on)) \
+                + int(self._side_exchanges(rt, node.right_on, lt,
+                                           node.left_on))
+        label = "plan.shuffle.join" if n_ex else "plan.join"
+        with _span(label, self._seq(), world=world, how=node.how,
+                   sides_exchanged=n_ex,
+                   rows_in=lt.capacity + rt.capacity):
             return lt.distributed_join(
                 rt, node.how, node.algorithm,
                 left_on=list(node.left_on), right_on=list(node.right_on))
@@ -118,7 +225,8 @@ class _Exec:
         t = self.run(node.children[0])
         ops = [table_mod._as_agg_op(o) for o in node.ops]
         if _world(self.ctx) == 1:
-            with _phase("plan.groupby", self._seq()):
+            with _span("plan.groupby", self._seq(), world=1,
+                       rows_in=t.capacity):
                 return table_mod.groupby_local(t, node.keys,
                                                node.agg_cols, ops)
         local = False
@@ -130,7 +238,8 @@ class _Exec:
                                             self.ctx.get_world_size())
             local = sig is not None and t._hash_partitioned == sig
         label = "plan.groupby" if local else "plan.shuffle.groupby"
-        with _phase(label, self._seq()):
+        with _span(label, self._seq(), world=_world(self.ctx),
+                   local=local, rows_in=t.capacity):
             return dist_ops.distributed_groupby(
                 t, node.keys, node.agg_cols, ops, pre_partitioned=local)
 
@@ -138,9 +247,12 @@ class _Exec:
         lt = self.run(node.children[0])
         rt = self.run(node.children[1])
         if _world(self.ctx) == 1:
-            with _phase("plan.setop", self._seq()):
+            with _span("plan.setop", self._seq(), world=1, op=node.op,
+                       rows_in=lt.capacity + rt.capacity):
                 return getattr(lt, node.op)(rt)
-        with _phase("plan.shuffle.setop", self._seq()):
+        with _span("plan.shuffle.setop", self._seq(),
+                   world=_world(self.ctx), op=node.op,
+                   rows_in=lt.capacity + rt.capacity):
             return getattr(lt, f"distributed_{node.op}")(rt)
 
     def _do_sort(self, node: ir.Sort) -> Table:
@@ -148,7 +260,9 @@ class _Exec:
 
         t = self.run(node.children[0])
         if _world(self.ctx) == 1:
-            with _phase("plan.sort", self._seq()):
+            with _span("plan.sort", self._seq(), world=1,
+                       rows_in=t.capacity):
                 return t.sort(node.by, node.ascending)
-        with _phase("plan.shuffle.sort", self._seq()):
+        with _span("plan.shuffle.sort", self._seq(),
+                   world=_world(self.ctx), rows_in=t.capacity):
             return dist_ops.distributed_sort(t, node.by, node.ascending)

@@ -23,7 +23,8 @@ from .. import table_api
 from ..data.table import Table
 from ..status import Code, CylonError
 from . import ir
-from .executor import execute as _execute
+from .executor import execute as _execute, \
+    execute_analyzed as _execute_analyzed
 from .optimizer import PlanStats, optimize as _optimize
 
 _JOIN_TYPES = ("inner", "left", "right", "outer", "full_outer")
@@ -164,21 +165,38 @@ class LazyTable:
         """(optimized plan root, PlanStats) — without executing."""
         return _optimize(self._plan_copy(), self._world())
 
-    def explain(self, optimize: bool = True) -> str:
+    def explain(self, optimize: bool = True, analyze: bool = False) -> str:
+        """The plan as text. ``analyze=True`` EXECUTES the query
+        (PostgreSQL EXPLAIN ANALYZE semantics) and renders the plan
+        annotated with measured rows/bytes/ms per node; the
+        `plan.report.PlanReport` behind the text is kept on
+        ``self.last_report`` for programmatic use."""
+        if analyze:
+            self.execute(optimize=optimize, analyze=True)
+            return self.last_report.render()
         if optimize:
             root, stats = self.optimized()
             return ir.format_plan(root) + f"\n-- {stats.summary()}"
         return ir.format_plan(self._node)
 
     def execute(self, optimize: bool = True,
-                out_id: Optional[str] = None) -> Table:
+                out_id: Optional[str] = None,
+                analyze: bool = False) -> Table:
         """Optimize, lower, run. The result is a concrete `Table`
-        (registered under ``out_id`` when given, table_api-style)."""
+        (registered under ``out_id`` when given, table_api-style).
+        ``analyze=True`` additionally records a per-node EXPLAIN
+        ANALYZE report on ``self.last_report`` (one row-count sync per
+        node — the default path pays nothing)."""
         root = self._plan_copy()
         stats: Optional[PlanStats] = None
         if optimize:
             root, stats = _optimize(root, self._world())
-        result = _execute(root, self._ctx)
+        if analyze:
+            result, report = _execute_analyzed(root, self._ctx,
+                                               stats=stats)
+            self.last_report = report
+        else:
+            result = _execute(root, self._ctx)
         if stats is not None:
             self.last_stats = stats
         if out_id is not None:

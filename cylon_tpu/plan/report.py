@@ -1,0 +1,163 @@
+"""Per-query EXPLAIN ANALYZE reports.
+
+`executor.execute_analyzed` records, for every plan node it lowers, the
+inclusive wall time, output rows/bytes, and the telemetry labels the
+node's own lowering emitted (children's labels excluded). This module
+shapes those measurements into a `PlanReport`:
+
+* ``render()`` — the optimized plan tree annotated PostgreSQL
+  EXPLAIN ANALYZE style: one ``(actual time=.. rows=.. bytes=..
+  shuffles=..)`` clause per node, plan-time optimizer stats and the
+  measured totals as trailing ``--`` lines. Shuffle markers folded
+  into a join's fused exchange render as ``(folded into parent
+  exchange)`` — they never execute standalone (executor docstring).
+* ``to_dict()`` — the machine-comparable form bench.py embeds in
+  BENCH_*.json artifacts (nested node records + global counters), so
+  the perf trajectory across rounds is diffable without parsing text.
+* ``span`` — the raw span TREE of the whole query (a telemetry.Span),
+  for JSONL export or programmatic walks.
+
+``shuffle_count`` counts the executed ``plan.shuffle*`` labels and is
+definitionally equal to ``collect_phases.count("plan.shuffle")`` over
+the same execution — both read the same label stream.
+
+Time semantics: ``ms`` is INCLUSIVE of children (Postgres "actual
+time"); host-visible wall clock, so async dispatch cost unless the
+node ends in a host sync (see telemetry docstring). Rows are LIVE rows
+(row_count, one scalar sync per node — only paid under analyze).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from . import ir
+
+
+def _human_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024.0
+    return f"{n:.1f} GiB"  # pragma: no cover
+
+
+@dataclass
+class NodeMeasure:
+    """One plan node's measured execution (or the reason it has none)."""
+
+    kind: str
+    desc: str                      # Type(args) — matches ir.format_plan
+    partitioned_by: Optional[tuple]
+    executed: bool
+    ms: Optional[float] = None     # inclusive wall time
+    rows: Optional[int] = None     # live output rows
+    bytes: Optional[int] = None    # output device bytes (Table.nbytes)
+    labels: List[str] = field(default_factory=list)  # own labels only
+    children: List["NodeMeasure"] = field(default_factory=list)
+
+    @property
+    def shuffles(self) -> int:
+        return sum(1 for l in self.labels if l.startswith("plan.shuffle"))
+
+    def line(self) -> str:
+        pb = f"  partitioned_by={tuple(self.partitioned_by)}" \
+            if self.partitioned_by is not None else ""
+        if not self.executed:
+            return f"{self.desc}{pb}  (folded into parent exchange)"
+        return (f"{self.desc}{pb}  (actual time={self.ms:.2f} ms, "
+                f"rows={self.rows}, bytes={_human_bytes(self.bytes)}, "
+                f"shuffles={self.shuffles})")
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind, "desc": self.desc,
+            "partitioned_by": list(self.partitioned_by)
+            if self.partitioned_by is not None else None,
+            "executed": self.executed,
+            "ms": round(self.ms, 3) if self.ms is not None else None,
+            "rows": self.rows, "bytes": self.bytes,
+            "shuffles": self.shuffles, "labels": list(self.labels),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+def build_measures(node: ir.PlanNode, recs: Dict[int, object],
+                   labels: List[str]) -> NodeMeasure:
+    """Shape the executor's per-node records into a NodeMeasure tree.
+
+    ``recs`` maps id(plan node) -> record with (i0, i1, ms, rows,
+    nbytes) where [i0, i1) indexes ``labels``. A node's OWN labels are
+    its inclusive range minus every executed descendant's range —
+    grandchildren under a folded (unexecuted) Shuffle still subtract
+    from the folding join's range."""
+    children = [build_measures(c, recs, labels) for c in node.children]
+    r = recs.get(id(node))
+    base = dict(kind=node.kind,
+                desc=f"{type(node).__name__}({node.args_repr()})",
+                partitioned_by=node.partitioned_by, children=children)
+    if r is None:
+        return NodeMeasure(executed=False, **base)
+    covered = [False] * (r.i1 - r.i0)
+    for d in ir.walk(node):
+        if d is node:
+            continue
+        dr = recs.get(id(d))
+        if dr is None:
+            continue
+        for i in range(max(dr.i0, r.i0), min(dr.i1, r.i1)):
+            covered[i - r.i0] = True
+    own = [labels[i] for i in range(r.i0, r.i1) if not covered[i - r.i0]]
+    return NodeMeasure(executed=True, ms=r.ms, rows=r.rows,
+                       bytes=r.nbytes, labels=own, **base)
+
+
+@dataclass
+class PlanReport:
+    """Programmatic EXPLAIN ANALYZE result for one ``collect()``."""
+
+    root: NodeMeasure
+    span: object                   # telemetry.Span tree of the query
+    shuffle_count: int             # == collect_phases.count("plan.shuffle")
+    total_ms: float
+    world: int
+    stats: Optional[object] = None     # optimizer.PlanStats (None when
+    #                                    executed with optimize=False)
+    memory: dict = field(default_factory=dict)   # sampled HBM gauges
+    metrics: dict = field(default_factory=dict)  # registry snapshot
+
+    def render(self) -> str:
+        def fmt(m: NodeMeasure, indent: str = "") -> List[str]:
+            out = [indent + m.line()]
+            for c in m.children:
+                out.extend(fmt(c, indent + "  "))
+            return out
+
+        lines = fmt(self.root)
+        if self.stats is not None:
+            lines.append(f"-- {self.stats.summary()}")
+        lines.append(f"-- measured: {self.total_ms:.2f} ms total, "
+                     f"{self.shuffle_count} exchange stage(s), "
+                     f"world={self.world}")
+        return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        d = {
+            "total_ms": round(self.total_ms, 3),
+            "shuffle_count": self.shuffle_count,
+            "world": self.world,
+            "plan": self.root.to_dict(),
+        }
+        if self.stats is not None:
+            d["optimizer"] = {
+                "shuffles_inserted": self.stats.shuffles_inserted,
+                "shuffles_elided": self.stats.shuffles_elided,
+                "groupbys_localized": self.stats.groupbys_localized,
+                "filters_pushed": self.stats.filters_pushed,
+                "columns_pruned": self.stats.columns_pruned,
+            }
+        if self.memory:
+            d["memory"] = dict(self.memory)
+        if self.metrics:
+            d["metrics"] = dict(self.metrics)
+        return d

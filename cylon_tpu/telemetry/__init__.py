@@ -1,0 +1,51 @@
+"""Structured tracing + metrics for the TPU dataframe engine.
+
+The reference's observability is per-phase wall-clock logging at every
+operator (cpp/src/cylon/table.cpp:320-335 shuffle timers, join/join.cpp
+per-phase logs). This package keeps that discipline — every label the
+old flat telemetry module emitted is still emitted, byte-identical —
+and grows it into a measurement layer:
+
+* ``spans``   — hierarchical, contextvar-nested spans with typed
+  attributes (rows/bytes/world/mode/error); ``phase``/``collect_phases``
+  are thin back-compat wrappers over it, so every pre-existing call
+  site participates in the span tree unchanged.
+* ``metrics`` — process-local counters (shuffle bytes, rows exchanged,
+  collective launches, kernel-factory builds = jit recompiles),
+  per-phase latency histograms, and HBM gauges sampled from
+  ``memory.MemoryPool`` (duck-typed; telemetry stays a base-layer
+  leaf).
+* ``export``  — JSONL span sink and Prometheus text dump; the
+  ``jax.profiler.TraceAnnotation`` carrier stays inside ``span`` so
+  Perfetto labels work with no exporter configured.
+
+The plan executor builds per-query EXPLAIN ANALYZE reports
+(plan/report.py) on this layer; docs/telemetry.md documents the span
+model, the attribute catalog and both exporter formats.
+
+Layering: this package is a BASE-LAYER LEAF (analysis/layering.py
+``telemetry-leaf`` contract) — it imports nothing from the package but
+its own submodules, and its underscore names are module-private
+(``layering/private-internals``).
+"""
+from __future__ import annotations
+
+from .spans import (Span, annotate, collect_phases, current_span,
+                    log_to_stderr, logger, phase, span, add_sink,
+                    remove_sink)
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      REGISTRY, counted_cache, counter, gauge, histogram,
+                      metrics_snapshot, reset_metrics, sample_memory)
+from .export import JsonlSpanSink, prometheus_text, span_to_json
+
+__all__ = [
+    # spans
+    "Span", "annotate", "collect_phases", "current_span", "log_to_stderr",
+    "logger", "phase", "span", "add_sink", "remove_sink",
+    # metrics
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "counted_cache", "counter", "gauge", "histogram", "metrics_snapshot",
+    "reset_metrics", "sample_memory",
+    # exporters
+    "JsonlSpanSink", "prometheus_text", "span_to_json",
+]

@@ -1,0 +1,111 @@
+"""Exporters: JSONL span sink + Prometheus text metrics dump.
+
+Two wire formats, both deliberately boring:
+
+* **JSONL trace** — one JSON object per COMPLETED span, written as
+  spans close (innermost first, so a child's line precedes its
+  parent's). ``parent_id`` links the tree; ``span_id`` 0 is "no
+  parent". Every line is independently parseable — a crashed process
+  leaves a valid prefix, and ``jq``/pandas ingest it directly.
+* **Prometheus text exposition** — the v0.0.4 text format rendered
+  from a MetricsRegistry: counters/gauges as single samples,
+  histograms as cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``.
+  Scrape-ready, and diff-able across BENCH rounds.
+
+The ``jax.profiler.TraceAnnotation`` carrier is NOT here — it lives
+inside spans.span itself, so Perfetto labels keep working with no
+exporter configured at all.
+"""
+from __future__ import annotations
+
+import json
+from typing import IO, List, Optional, Union
+
+from . import spans as _spans
+from .metrics import REGISTRY, MetricsRegistry, format_series
+
+
+def span_to_json(span) -> str:
+    """One flat JSONL record for a completed span."""
+    return json.dumps(span.to_dict(), default=str, sort_keys=True)
+
+
+class JsonlSpanSink:
+    """Context manager that streams every completed span to a JSONL
+    file (path or open file object) while active::
+
+        with telemetry.JsonlSpanSink("/tmp/trace.jsonl"):
+            pipe.execute()
+
+    Nesting multiple sinks is fine — each sees every span."""
+
+    def __init__(self, target: Union[str, IO]):
+        self._target = target
+        self._file: Optional[IO] = None
+        self._owns_file = False
+        self.spans_written = 0
+        # registration handle: accessing self._write builds a FRESH
+        # bound-method object on every attribute access, so the
+        # identity-based remove_sink must be handed the exact object
+        # add_sink saw
+        self._registered = self._write
+
+    def _write(self, span) -> None:
+        self._file.write(span_to_json(span) + "\n")
+        self.spans_written += 1
+
+    def __enter__(self) -> "JsonlSpanSink":
+        if isinstance(self._target, str):
+            self._file = open(self._target, "w", encoding="utf-8")
+            self._owns_file = True
+        else:
+            self._file = self._target
+        _spans.add_sink(self._registered)
+        return self
+
+    def __exit__(self, *exc):
+        _spans.remove_sink(self._registered)
+        if self._owns_file:
+            self._file.close()
+        else:
+            self._file.flush()
+        self._file = None
+        return False
+
+
+def _fmt(v) -> str:
+    # prometheus floats: integers render bare, floats keep precision
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render a registry in the Prometheus text exposition format.
+    Series sort by (name, labels); one ``# TYPE`` line per metric
+    name."""
+    reg = registry or REGISTRY
+    lines: List[str] = []
+    typed = set()
+    for name, labels, m in reg.series():
+        if name not in typed:
+            lines.append(f"# TYPE {name} {m.kind}")
+            typed.add(name)
+        if m.kind in ("counter", "gauge"):
+            lines.append(f"{format_series(name, labels)} {_fmt(m.value)}")
+            continue
+        # histogram: cumulative buckets + sum + count
+        cum = 0
+        for bound, c in zip(m.buckets, m.counts):
+            cum += c
+            lbl = labels + (("le", _fmt(bound)),)
+            lines.append(f"{format_series(name + '_bucket', lbl)} {cum}")
+        cum += m.counts[-1]
+        lbl = labels + (("le", "+Inf"),)
+        lines.append(f"{format_series(name + '_bucket', lbl)} {cum}")
+        lines.append(f"{format_series(name + '_sum', labels)} "
+                     f"{_fmt(m.sum)}")
+        lines.append(f"{format_series(name + '_count', labels)} {m.count}")
+    return "\n".join(lines) + "\n"

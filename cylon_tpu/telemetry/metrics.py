@@ -1,0 +1,249 @@
+"""Metrics registry: counters, histograms, gauges.
+
+The quantitative half of the observability layer — where spans answer
+"what ran and how long", the registry accumulates the signals the
+reference logs and then drops (rows exchanged, shuffle bytes, HBM
+watermarks, program builds). Everything is process-local, cheap
+(plain attribute adds under the GIL), and exported either as a plain
+dict (``snapshot()`` — the BENCH artifact form) or Prometheus text
+(export.prometheus_text).
+
+Well-known series (full catalog: docs/telemetry.md):
+
+* ``cylon_shuffle_bytes_total``       payload bytes through exchanges
+* ``cylon_rows_exchanged_total``      live rows moved by exchanges
+* ``cylon_collective_launches_total`` compiled collective dispatches
+* ``cylon_kernel_factory_builds_total{factory=...}`` jit program builds
+  (each miss of a ``counted_cache`` kernel factory is one new XLA
+  compilation — the recompile counter)
+* ``cylon_phase_latency_ms{phase=...}`` per-span latency histogram
+  (fed by spans.span on every close)
+* ``cylon_hbm_*_bytes`` / ``cylon_comm_budget_bytes`` gauges sampled
+  from a ``memory.MemoryPool`` via ``sample_memory`` (duck-typed —
+  telemetry stays a base-layer leaf and never imports memory.py)
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+class Counter:
+    """Monotonically increasing value."""
+
+    kind = "counter"
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n=1) -> None:
+        self.value += n
+
+    def zero(self) -> None:
+        self.value = 0
+
+
+class Gauge:
+    """Last-sampled value."""
+
+    kind = "gauge"
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def set(self, v) -> None:
+        self.value = v
+
+    def zero(self) -> None:
+        self.value = 0
+
+
+# latency bucket bounds in ms (log-ish spacing spanning one kernel
+# dispatch to one axon-tunnel round trip and beyond)
+DEFAULT_BUCKETS_MS = (0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
+                      1000.0, 5000.0)
+
+
+class Histogram:
+    """Cumulative-bucket histogram with sum/count/min/max."""
+
+    kind = "histogram"
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, buckets=DEFAULT_BUCKETS_MS):
+        self.buckets = tuple(buckets)
+        self.zero()
+
+    def zero(self) -> None:
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v: float) -> None:
+        i = 0
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                break
+        else:
+            i = len(self.buckets)
+        self.counts[i] += 1
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+
+def _series_key(name: str, labels: Optional[Dict[str, str]]) -> tuple:
+    return name, tuple(sorted((labels or {}).items()))
+
+
+def format_series(name: str, labels: LabelKey) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class MetricsRegistry:
+    """Name+labels → metric instance. ``reset()`` zeroes IN PLACE so
+    references held by instrumented code (counted_cache closures, span
+    histograms) stay live across test resets."""
+
+    def __init__(self):
+        self._metrics: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, labels=None, **kw):
+        key = _series_key(name, labels)
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.setdefault(key, cls(**kw))
+        if not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} already registered as "
+                            f"{type(m).__name__}, not {cls.__name__}")
+        return m
+
+    def counter(self, name: str, labels=None) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, labels=None) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, labels=None,
+                  buckets=DEFAULT_BUCKETS_MS) -> Histogram:
+        return self._get(Histogram, name, labels, buckets=buckets)
+
+    def series(self):
+        """Sorted [(name, labels, metric)] — the exporters' view."""
+        return [(n, l, m)
+                for (n, l), m in sorted(self._metrics.items(),
+                                        key=lambda kv: kv[0])]
+
+    def snapshot(self) -> dict:
+        """Plain JSON-able dict keyed by the rendered series name —
+        counters/gauges map to their value, histograms to
+        {count, sum, min, max}. The BENCH artifact form."""
+        out = {}
+        for name, labels, m in self.series():
+            key = format_series(name, labels)
+            if m.kind == "histogram":
+                out[key] = {"count": m.count, "sum": round(m.sum, 3),
+                            "min": m.min, "max": m.max}
+            else:
+                out[key] = m.value
+        return out
+
+    def reset(self) -> None:
+        for m in self._metrics.values():
+            m.zero()
+
+
+# the process-global default registry — module-level helpers below and
+# the instrumented call sites (parallel/shuffle.py, spans.py) all feed it
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, labels=None) -> Counter:
+    return REGISTRY.counter(name, labels)
+
+
+def gauge(name: str, labels=None) -> Gauge:
+    return REGISTRY.gauge(name, labels)
+
+
+def histogram(name: str, labels=None) -> Histogram:
+    return REGISTRY.histogram(name, labels)
+
+
+def metrics_snapshot() -> dict:
+    return REGISTRY.snapshot()
+
+
+def reset_metrics() -> None:
+    REGISTRY.reset()
+
+
+def observe_phase(name: str, elapsed_ms: float, error: bool = False
+                  ) -> None:
+    """Per-span latency histogram feed (called by spans.span on close;
+    the seq suffix is already stripped — label cardinality stays the
+    static set of span names)."""
+    REGISTRY.histogram("cylon_phase_latency_ms",
+                       {"phase": name}).observe(elapsed_ms)
+    if error:
+        REGISTRY.counter("cylon_phase_errors_total",
+                         {"phase": name}).inc()
+
+
+def counted_cache(fn: Callable) -> Callable:
+    """``lru_cache(maxsize=None)`` plus a build counter — the drop-in
+    decorator for the jit kernel-factory memo layer. Every cache miss
+    builds (and on first call compiles) a new XLA program, so
+    ``cylon_kernel_factory_builds_total{factory=...}`` IS the
+    jit-recompile counter: a hot loop that grows it is paying
+    compilation, not compute."""
+    c = REGISTRY.counter("cylon_kernel_factory_builds_total",
+                         {"factory": fn.__name__})
+
+    def _build(*args, **kwargs):
+        c.inc()
+        return fn(*args, **kwargs)
+
+    cached = functools.lru_cache(maxsize=None)(_build)
+    try:
+        functools.update_wrapper(cached, fn)
+    except Exception:  # pragma: no cover - exotic callables
+        pass
+    return cached
+
+
+def sample_memory(pool, registry: Optional[MetricsRegistry] = None
+                  ) -> dict:
+    """Sample a ``memory.MemoryPool`` into gauges; returns the sampled
+    values as a dict. Duck-typed (bytes_allocated/peak_bytes/
+    bytes_limit/available_bytes/comm_budget_bytes) so the base-leaf
+    layering contract holds — telemetry never imports memory.py.
+    ``available``/``comm_budget`` may be None off-TPU; their gauges are
+    then left untouched and the dict carries None."""
+    r = registry or REGISTRY
+    vals = {
+        "hbm_live_bytes": int(pool.bytes_allocated()),
+        "hbm_peak_bytes": int(pool.peak_bytes()),
+        "hbm_limit_bytes": int(pool.bytes_limit()),
+        "hbm_available_bytes": pool.available_bytes(),
+        "comm_budget_bytes": pool.comm_budget_bytes(),
+    }
+    for key, v in vals.items():
+        if v is not None:
+            r.gauge(f"cylon_{key}").set(int(v))
+    r.gauge("cylon_hbm_stats_available").set(
+        int(vals["hbm_available_bytes"] is not None))
+    return vals

@@ -1,0 +1,222 @@
+"""Hierarchical spans + the phase-timer back-compat surface.
+
+The reference's observability is pervasive manual wall-clock timing with
+glog at every operator phase (reference: cpp/src/cylon/table.cpp:320-335
+shuffle timing; join/join.cpp:101-253 per-phase logs; arrow_hash_kernels.hpp
+:120,163 build/probe timers). Here the same discipline rides three carriers:
+
+* a ``logging`` logger named ``cylon_tpu`` — every span logs its
+  host-side elapsed time at INFO on exit. JAX dispatch is async: unless
+  a span ends in a host sync (the count→materialize scalar fetches do),
+  the time logged is dispatch+trace cost, not device time. That is
+  exactly what the phase discipline is for — spotting recompiles and
+  host round-trips, the things the host can see.
+* ``jax.profiler.TraceAnnotation`` — the same label appears in
+  TensorBoard / Perfetto traces captured with ``jax.profiler.trace``,
+  where the DEVICE time lives. ``seq`` carries the context's op
+  sequence number, the moral heir of the reference's MPI edge/tag id
+  (ctx/cylon_context.cpp:94-99).
+* a contextvar-scoped `Span` TREE — spans opened inside another span
+  become its children, carry typed attributes (``rows_in``/``rows_out``,
+  ``bytes_moved``, ``world``, ``mode``, error flag), and feed the
+  registered sinks (export.JsonlSpanSink) and the per-phase latency
+  histogram (metrics) on completion. The plan executor's per-query
+  EXPLAIN ANALYZE report (plan/report.py) is built on this tree.
+
+``phase(name, seq)`` is the original module's API, now a thin wrapper
+over ``span`` — all pre-package call sites keep their exact semantics
+(label format ``name#seq``, one INFO line per span, collect_phases
+label counting). New in the package: the body is wrapped in
+try/finally, so a raising phase still records its elapsed time, gains
+an ``error=True`` attribute, logs, and re-raises (the old module
+silently dropped the measurement on the floor).
+
+Enable host-side logs with ``logging.getLogger("cylon_tpu").setLevel(
+logging.INFO)`` plus a handler, or ``cylon_tpu.telemetry.log_to_stderr()``.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional
+
+import jax
+
+from . import metrics as _metrics
+
+logger = logging.getLogger("cylon_tpu")
+
+# active phase collectors (collect_phases contexts) — every entered
+# span appends its label to each, so callers can COUNT events (e.g. a
+# query plan's shuffles) without wiring a logging handler
+_collectors: list = []
+
+# completed-span sinks (add_sink/remove_sink); each is called with every
+# Span as it CLOSES — the JSONL exporter registers here
+_sinks: List[Callable] = []
+
+_span_ids = itertools.count(1)
+
+# innermost open span of the current (async/thread) context, or None
+_current: ContextVar[Optional["Span"]] = ContextVar(
+    "cylon_tpu_current_span", default=None)
+
+
+@dataclass
+class Span:
+    """One timed operation with typed attributes and child spans.
+
+    ``elapsed_ms`` is None while the span is open; ``attrs`` holds the
+    attribute catalog documented in docs/telemetry.md (``rows_in``,
+    ``rows_out``, ``bytes_moved``, ``world``, ``mode``, ``error``...).
+    """
+
+    name: str
+    seq: Optional[int] = None
+    attrs: Dict[str, object] = field(default_factory=dict)
+    children: List["Span"] = field(default_factory=list)
+    span_id: int = 0
+    parent_id: int = 0
+    elapsed_ms: Optional[float] = None
+    error: bool = False
+    _t0: float = 0.0
+
+    @property
+    def label(self) -> str:
+        return f"{self.name}#{self.seq}" if self.seq is not None \
+            else self.name
+
+    def set(self, **attrs) -> "Span":
+        """Attach/overwrite attributes on this span."""
+        self.attrs.update(attrs)
+        return self
+
+    def walk(self) -> Iterator["Span"]:
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+    def to_dict(self, nested: bool = False) -> dict:
+        """Flat JSON-able record (parent_id links the tree); pass
+        ``nested=True`` to embed children instead."""
+        d = {"span_id": self.span_id, "parent_id": self.parent_id,
+             "name": self.name, "seq": self.seq,
+             "elapsed_ms": self.elapsed_ms, "error": self.error,
+             "attrs": dict(self.attrs)}
+        if nested:
+            d["children"] = [c.to_dict(nested=True) for c in self.children]
+        return d
+
+
+def current_span() -> Optional[Span]:
+    """The innermost open span of this context, or None."""
+    return _current.get()
+
+
+def annotate(**attrs) -> None:
+    """Attach attributes to the innermost open span (no-op outside any
+    span) — lets deep helpers report ``rows``/``bytes`` without
+    threading the Span object through every signature."""
+    s = _current.get()
+    if s is not None:
+        s.attrs.update(attrs)
+
+
+def add_sink(sink: Callable) -> None:
+    """Register a completed-span sink: ``sink(span)`` runs as each span
+    closes (innermost first). Exceptions are logged, never raised."""
+    _sinks.append(sink)
+
+
+def remove_sink(sink: Callable) -> None:
+    for i, s in enumerate(_sinks):
+        if s is sink:
+            del _sinks[i]
+            break
+
+
+class collect_phases:
+    """Collect every span label entered inside the context — the
+    programmatic mirror of the INFO log stream. ``count(prefix)``
+    answers questions like "how many shuffles did this plan run?"
+    (prefix="plan.shuffle"); labels keep their ``name#seq`` form."""
+
+    def __init__(self):
+        self.labels: list = []
+
+    def __enter__(self) -> "collect_phases":
+        _collectors.append(self.labels)
+        return self
+
+    def __exit__(self, *exc):
+        # remove by IDENTITY: list.remove compares by ==, and two nested
+        # collectors with equal contents would remove each other's lists
+        for i, l in enumerate(_collectors):
+            if l is self.labels:
+                del _collectors[i]
+                break
+        return False
+
+    def count(self, prefix: str) -> int:
+        return sum(1 for l in self.labels if l.startswith(prefix))
+
+
+def log_to_stderr(level: int = logging.INFO) -> None:
+    """Convenience: route cylon_tpu phase logs to stderr (idempotent)."""
+    if not any(getattr(h, "_cylon_tpu", False) for h in logger.handlers):
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(message)s"))
+        handler._cylon_tpu = True
+        logger.addHandler(handler)
+    logger.setLevel(level)
+
+
+@contextmanager
+def span(name: str, seq: Optional[int] = None, **attrs) -> Iterator[Span]:
+    """Open one span: time it, nest it under the current span, annotate
+    device traces with the same label, feed sinks and the per-phase
+    latency histogram on close. Yields the Span so the body can
+    ``s.set(rows_out=...)``. Exceptions re-raise after the span records
+    ``error=True`` and its elapsed time (the fixed phase() bug)."""
+    parent = _current.get()
+    s = Span(name, seq, dict(attrs), span_id=next(_span_ids),
+             parent_id=parent.span_id if parent is not None else 0)
+    label = s.label
+    for c in _collectors:
+        c.append(label)
+    if parent is not None:
+        parent.children.append(s)
+    token = _current.set(s)
+    s._t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(f"cylon:{label}"):
+            yield s
+    except BaseException:
+        s.error = True
+        s.attrs["error"] = True
+        raise
+    finally:
+        s.elapsed_ms = (time.perf_counter() - s._t0) * 1e3
+        _current.reset(token)
+        _metrics.observe_phase(s.name, s.elapsed_ms, error=s.error)
+        for sink in list(_sinks):
+            try:
+                sink(s)
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("span sink failed")
+        if logger.isEnabledFor(logging.INFO):
+            logger.info("%s %.3f ms%s", label, s.elapsed_ms,
+                        " error=True" if s.error else "")
+
+
+def phase(name: str, seq: Optional[int] = None):
+    """Time one operator phase; annotate device traces with the same
+    label. The original telemetry.py API — now a span with no
+    attributes, so every pre-package call site participates in the
+    span tree unchanged."""
+    return span(name, seq)

@@ -1,13 +1,19 @@
 #!/bin/sh
-# One-stop verification gate: static analysis + tier-1 tests (ROADMAP.md).
-# Usage: sh scripts/check.sh
+# One-stop verification gate: static analysis + telemetry smoke +
+# tier-1 tests (ROADMAP.md). Usage: sh scripts/check.sh
 set -e
 cd "$(dirname "$0")/.."
 
 echo "== static analysis: python -m cylon_tpu.analysis =="
-# all four checker families (layering, hostsync, collectives, witness);
-# any unsuppressed finding fails the gate before tests run
+# all five checker families (layering, hostsync, collectives, witness,
+# span-coverage); any unsuppressed finding fails the gate before tests
 python -m cylon_tpu.analysis
+
+echo "== telemetry smoke: scripts/smoke_telemetry.py =="
+# a two-shuffle pipeline must produce a parseable JSONL trace, a
+# Prometheus dump with nonzero shuffle_bytes_total, and an EXPLAIN
+# ANALYZE report whose shuffle count matches the phase labels
+python scripts/smoke_telemetry.py
 
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu exec python -m pytest tests/ -q -m 'not slow' \

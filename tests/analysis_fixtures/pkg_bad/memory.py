@@ -2,8 +2,6 @@
 package (base-leaf contract)."""
 from . import sneaky  # SEEDED: layering/base-leaf
 
-_collectors = []
 
-
-def phase(name):
-    return name
+def pool():
+    return sneaky

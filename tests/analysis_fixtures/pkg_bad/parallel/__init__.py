@@ -1,0 +1,1 @@
+"""Fixture parallel package (span-coverage checker scope)."""

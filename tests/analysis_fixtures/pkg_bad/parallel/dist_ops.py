@@ -1,0 +1,21 @@
+"""Fixture distributed ops for the span-coverage checker: one spanned
+op (clean), one bare op (seeded), plus a private helper and a
+non-distributed public function — both outside the contract."""
+from ..telemetry import phase as _phase
+
+
+def distributed_spanned(t):
+    with _phase("distributed_spanned.work", 0):
+        return t
+
+
+def distributed_bare(t):  # SEEDED: span-coverage/missing-span
+    return t + 1
+
+
+def _helper(t):  # private: outside the contract
+    return t
+
+
+def repartition_like(t):  # public but not distributed_*: outside
+    return t

@@ -1,0 +1,15 @@
+"""Fixture executor for the span-coverage checker: one spanned lowering
+(clean), one bare lowering (seeded)."""
+from ..telemetry import phase as _phase
+
+
+class _Exec:
+    def _do_spanned(self, node):
+        with _phase("plan.spanned"):
+            return node
+
+    def _do_bare(self, node):  # SEEDED: span-coverage/missing-span
+        return node
+
+    def run(self, node):  # not a _do_* lowering: outside the contract
+        return node

@@ -1,8 +1,16 @@
-"""Fixture module reaching into telemetry's span internals."""
+"""Fixture module reaching into telemetry's span internals — both the
+pre-split module forms and the post-split package/submodule forms."""
 from . import telemetry
 from .telemetry import _collectors  # SEEDED: layering/private-internals
+from .telemetry import spans
+from .telemetry.spans import _collectors as _c2  # SEEDED: layering/private-internals
 
 
 def leak():
     # SEEDED: layering/private-internals (attribute access form)
     return telemetry._collectors + _collectors
+
+
+def leak_submodule():
+    # SEEDED: layering/private-internals (submodule attribute form)
+    return spans._collectors + _c2

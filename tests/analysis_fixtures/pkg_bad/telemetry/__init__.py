@@ -1,0 +1,10 @@
+"""Fixture telemetry PACKAGE (the module→package split shape): its own
+submodule imports are allowed; reaching back into the package is not."""
+from . import spans  # ok: intra-telemetry (allow=("telemetry",))
+from .. import sneaky  # SEEDED: layering/telemetry-leaf
+
+_collectors = spans._collectors  # ok: owner touches its own internals
+
+
+def phase(name):
+    return spans.phase(name)

@@ -1,0 +1,7 @@
+"""Fixture telemetry submodule holding the span internals."""
+
+_collectors = []
+
+
+def phase(name):
+    return name

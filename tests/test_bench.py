@@ -48,3 +48,25 @@ def test_full_suite_small(local_ctx):
         assert name in suite, f"missing config {name}"
         assert "error" not in suite[name], (name, suite[name])
     json.dumps(res)
+
+
+def test_plan_pipeline_emits_reports_and_metrics(local_ctx):
+    """The plan_pipeline config carries the measurement layer's own
+    outputs: per-query EXPLAIN ANALYZE reports and the metrics delta —
+    not hand-rolled dicts."""
+    ctx = bench._mk_ctx()
+    res = bench.bench_plan_pipeline(ctx, 1 << 10, iters=1)
+    for key in ("plan_report", "eager_report", "metrics"):
+        assert key in res, res.keys()
+    assert res["plan_report"]["plan"]["kind"] == "groupby"
+    assert res["plan_report"]["plan"]["rows"] is not None
+    assert res["plan_report"]["total_ms"] > 0
+    assert res["plan_report"]["optimizer"]["groupbys_localized"] == 1
+    # shuffle counts in the report are the executed plan.shuffle labels
+    assert res["eager_report"]["shuffle_count"] >= \
+        res["plan_report"]["shuffle_count"]
+    for section in ("eager", "planned"):
+        m = res["metrics"][section]
+        assert m["cylon_shuffle_bytes_total"] >= 0
+        assert m["cylon_collective_launches_total"] >= 0
+    json.dumps(res)  # artifact stays one-line serializable

@@ -431,3 +431,110 @@ def test_explain_mentions_elision(dist_ctx):
     txt = plan.scan(lp).join(plan.scan(right), on="k").explain()
     assert "elided" in txt and "Shuffle" in txt
     assert "partitioned_by" in txt
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE (per-query PlanReport)
+# ---------------------------------------------------------------------------
+
+
+def test_explain_analyze_bench_pipeline_shuffle_counts(dist_ctx):
+    """The acceptance pin: on the plan_pipeline bench query shape
+    (join on k → groupby on k), explain(analyze=True) shows per-node
+    measured rows/bytes/ms, and its reported shuffle count equals
+    collect_phases.count("plan.shuffle") — 1 optimized vs 2 eager."""
+    left, right = make_tables(dist_ctx, seed=41)
+    pipe = plan.scan(left).join(plan.scan(right), on="k") \
+        .groupby("lt-0", ["rt-4"], ["sum"])
+
+    with telemetry.collect_phases() as cp:
+        txt = pipe.explain(analyze=True)
+    rep = pipe.last_report
+    assert rep.shuffle_count == cp.count("plan.shuffle") == 1
+    assert "actual time=" in txt and "rows=" in txt and "bytes=" in txt
+    assert "folded into parent exchange" in txt  # join-side markers
+
+    with telemetry.collect_phases() as cp2:
+        pipe.explain(optimize=False, analyze=True)
+    rep2 = pipe.last_report
+    assert rep2.shuffle_count == cp2.count("plan.shuffle") == 2
+    assert rep2.stats is None  # unoptimized run carries no PlanStats
+
+
+def test_explain_analyze_report_measures(dist_ctx):
+    left, right = make_tables(dist_ctx, seed=43)
+    pipe = plan.scan(left).join(plan.scan(right), on="k") \
+        .groupby("lt-0", ["rt-4"], ["sum"])
+    out = pipe.execute(analyze=True)
+    rep = pipe.last_report
+
+    # root measure mirrors the executed result exactly
+    assert rep.root.kind == "groupby"
+    assert rep.root.rows == out.row_count
+    assert rep.root.bytes == out.nbytes > 0
+    assert rep.root.ms is not None and rep.root.ms > 0
+    assert rep.world == 4
+    # inclusive timing: the root's wall time bounds its child's
+    join_m = rep.root.children[0]
+    assert join_m.kind == "join" and join_m.ms <= rep.root.ms
+    assert join_m.shuffles == 1  # plan.shuffle.join is the join's own
+    # the span tree of the whole query, rooted at plan.query
+    assert rep.span.name == "plan.query"
+    names = [s.name for s in rep.span.walk()]
+    assert "plan.shuffle.join" in names and "shuffle.exchange_pair" in names
+    # machine-comparable form round-trips through JSON
+    import json
+
+    d = json.loads(json.dumps(rep.to_dict()))
+    assert d["shuffle_count"] == 1
+    assert d["plan"]["kind"] == "groupby"
+    assert d["optimizer"]["groupbys_localized"] == 1
+    # analyze result matches the plain execution bit-for-bit
+    import pandas as pd
+
+    pd.testing.assert_frame_equal(canon(out), canon(pipe.execute()),
+                                  check_dtype=False)
+
+
+def test_execute_default_path_records_no_report(dist_ctx):
+    left, right = make_tables(dist_ctx, seed=45)
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    before = getattr(pipe, "last_report", None)
+    pipe.execute()
+    assert getattr(pipe, "last_report", None) is before
+
+
+def test_explain_analyze_world1(local_ctx):
+    """EXPLAIN ANALYZE on a local context: zero exchanges reported,
+    measures still populated."""
+    left, right = make_tables(local_ctx, seed=47)
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    txt = pipe.explain(analyze=True)
+    rep = pipe.last_report
+    assert rep.shuffle_count == 0 and rep.world == 1
+    assert "rows=" in txt
+
+
+def test_promoting_join_labels_count_only_promoted_side(dist_ctx):
+    """Label honesty under promoting alignment (review fix): a side
+    already at the promoted common dtype keeps its witness and is
+    skipped by distributed_join — the span must count ONE exchanged
+    side, not two."""
+    rng = np.random.default_rng(51)
+    n = 2000
+    left = ct.Table.from_pydict(dist_ctx, {
+        "k": rng.integers(0, n // 4, n).astype(np.int64),
+        "v": rng.normal(size=n).astype(np.float32)})
+    right = ct.Table.from_pydict(dist_ctx, {
+        "k": rng.integers(0, n // 4, n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32)})
+    lp = ct.distribute_by_key(left, dist_ctx, ["k"])  # int64 witness
+    pipe = plan.scan(lp).join(plan.scan(right), on="k")
+    pipe.execute(analyze=True)
+    joins = [s for s in pipe.last_report.span.walk()
+             if s.name in ("plan.shuffle.join", "plan.join")]
+    assert len(joins) == 1
+    # right promotes int32->int64 and must exchange; the witnessed
+    # int64 left side is skipped (mirrors dist_ops' aligned-sig check)
+    assert joins[0].name == "plan.shuffle.join"
+    assert joins[0].attrs["sides_exchanged"] == 1, joins[0].attrs

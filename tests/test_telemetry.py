@@ -1,8 +1,13 @@
 """Phase-timer/observability tests (the reference's timing-log discipline,
-reference: cpp/src/cylon/table.cpp:320-335)."""
+reference: cpp/src/cylon/table.cpp:320-335) — plus the telemetry
+package's span tree, metrics registry and exporters. The first block
+pins the pre-package phase()/collect_phases semantics EXACTLY (the
+module→package split must be invisible to every existing call site)."""
+import json
 import logging
 
 import numpy as np
+import pytest
 
 
 def test_phase_logs_emitted(local_ctx, caplog):
@@ -51,3 +56,221 @@ def test_row_count_cached(local_ctx):
     assert t.row_count == 10
     t.row_mask = jnp.arange(16) < 4  # setter invalidates the cache
     assert t.row_count == 4
+
+
+# ---------------------------------------------------------------------------
+# back-compat pins: the module→package split must not change phase()
+# ---------------------------------------------------------------------------
+
+
+def test_phase_log_line_format_pinned(caplog):
+    """The INFO line stays exactly '<label> <ms> ms' on success — log
+    scrapers and the docs' worked examples depend on it."""
+    from cylon_tpu import telemetry
+
+    with caplog.at_level(logging.INFO, logger="cylon_tpu"):
+        with telemetry.phase("fmt.check", 7):
+            pass
+    msgs = [r.message for r in caplog.records]
+    assert len(msgs) == 1
+    label, ms, unit = msgs[0].split()
+    assert label == "fmt.check#7" and unit == "ms" and float(ms) >= 0
+
+
+def test_phase_error_path_records_and_reraises(caplog):
+    """The satellite bugfix: a raising body must still log its elapsed
+    time, mark the span error=True, and re-raise (the old module
+    dropped the measurement on the floor)."""
+    from cylon_tpu import telemetry
+
+    with caplog.at_level(logging.INFO, logger="cylon_tpu"):
+        with telemetry.collect_phases() as cp:
+            with pytest.raises(ValueError, match="boom"):
+                with telemetry.span("err.phase", 3) as sp:
+                    raise ValueError("boom")
+    assert cp.labels == ["err.phase#3"]
+    assert sp.error is True and sp.attrs["error"] is True
+    assert sp.elapsed_ms is not None and sp.elapsed_ms >= 0
+    msgs = [r.message for r in caplog.records]
+    assert any(m.startswith("err.phase#3 ") and "error=True" in m
+               for m in msgs), msgs
+
+
+def test_phase_error_path_via_phase_wrapper():
+    from cylon_tpu import telemetry
+
+    with telemetry.collect_phases() as cp:
+        with pytest.raises(RuntimeError):
+            with telemetry.phase("err.wrap"):
+                raise RuntimeError("x")
+    assert cp.labels == ["err.wrap"]
+    snap = telemetry.metrics_snapshot()
+    assert snap.get('cylon_phase_errors_total{phase="err.wrap"}', 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# span tree + attributes
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_attrs():
+    from cylon_tpu import telemetry
+
+    with telemetry.span("outer", 1, world=4) as outer:
+        with telemetry.span("inner.a") as a:
+            a.set(rows_out=10)
+            telemetry.annotate(bytes_moved=80)
+        with telemetry.span("inner.b"):
+            pass
+    assert [c.name for c in outer.children] == ["inner.a", "inner.b"]
+    assert a.parent_id == outer.span_id
+    assert outer.attrs == {"world": 4}
+    assert a.attrs == {"rows_out": 10, "bytes_moved": 80}
+    assert all(s.elapsed_ms is not None for s in outer.walk())
+    nested = outer.to_dict(nested=True)
+    assert [c["name"] for c in nested["children"]] == ["inner.a", "inner.b"]
+
+
+def test_annotate_outside_span_is_noop():
+    from cylon_tpu import telemetry
+
+    telemetry.annotate(rows=1)  # must not raise
+    assert telemetry.current_span() is None
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_counter_gauge_histogram_and_reset():
+    from cylon_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", {"k": "a"})
+    c.inc()
+    c.inc(4)
+    reg.gauge("t_gauge").set(17)
+    h = reg.histogram("t_hist")
+    for v in (0.05, 3.0, 7000.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap['t_total{k="a"}'] == 5
+    assert snap["t_gauge"] == 17
+    assert snap["t_hist"]["count"] == 3
+    assert snap["t_hist"]["min"] == 0.05 and snap["t_hist"]["max"] == 7000.0
+    # reset zeroes IN PLACE: held references stay live
+    reg.reset()
+    assert c.value == 0
+    c.inc()
+    assert reg.snapshot()['t_total{k="a"}'] == 1
+    # a name cannot change metric type
+    with pytest.raises(TypeError):
+        reg.gauge("t_total", {"k": "a"})
+
+
+def test_counted_cache_counts_builds_only():
+    from cylon_tpu import telemetry
+    from cylon_tpu.telemetry import counted_cache
+
+    calls = []
+
+    @counted_cache
+    def factory_under_test(x):
+        calls.append(x)
+        return x * 2
+
+    c = telemetry.counter("cylon_kernel_factory_builds_total",
+                          {"factory": "factory_under_test"})
+    before = c.value
+    assert factory_under_test(3) == 6
+    assert factory_under_test(3) == 6  # cache hit: no build
+    assert factory_under_test(4) == 8
+    assert calls == [3, 4]
+    assert c.value - before == 2
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_sink_round_trip(tmp_path):
+    from cylon_tpu import telemetry
+
+    path = tmp_path / "trace.jsonl"
+    with telemetry.JsonlSpanSink(str(path)) as sink:
+        with telemetry.span("q", 1, world=2):
+            with telemetry.span("q.child"):
+                pass
+    assert sink.spans_written == 2
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) == 2
+    by_name = {l["name"]: l for l in lines}
+    # children close first; parent_id links the tree
+    assert lines[0]["name"] == "q.child"
+    assert by_name["q.child"]["parent_id"] == by_name["q"]["span_id"]
+    assert by_name["q"]["attrs"] == {"world": 2}
+    assert all(l["elapsed_ms"] >= 0 for l in lines)
+
+
+def test_jsonl_sink_unregisters_on_exit(tmp_path):
+    """Regression: remove_sink is identity-based and self._write builds
+    a fresh bound method per access — the sink must hand back the exact
+    object it registered, or every later span crashes into the closed
+    file."""
+    from cylon_tpu import telemetry
+    from cylon_tpu.telemetry import spans as _spans
+
+    path = tmp_path / "trace.jsonl"
+    n_before = len(_spans._sinks)
+    with telemetry.JsonlSpanSink(str(path)):
+        with telemetry.span("inside"):
+            pass
+    assert len(_spans._sinks) == n_before
+    with telemetry.span("outside"):  # must not feed the closed sink
+        pass
+    lines = path.read_text().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["name"] == "inside"
+
+
+def test_prometheus_text_format():
+    from cylon_tpu.telemetry import MetricsRegistry
+    from cylon_tpu.telemetry.export import prometheus_text
+
+    reg = MetricsRegistry()
+    reg.counter("cylon_shuffle_bytes_total").inc(1024)
+    reg.gauge("cylon_hbm_live_bytes").set(5)
+    reg.histogram("cylon_lat_ms", {"phase": "x"},
+                  buckets=(1.0, 10.0)).observe(2.0)
+    text = prometheus_text(reg)
+    assert "# TYPE cylon_shuffle_bytes_total counter" in text
+    assert "cylon_shuffle_bytes_total 1024" in text
+    assert "cylon_hbm_live_bytes 5" in text
+    assert 'cylon_lat_ms_bucket{phase="x",le="1.0"} 0' in text
+    assert 'cylon_lat_ms_bucket{phase="x",le="10.0"} 1' in text
+    assert 'cylon_lat_ms_bucket{phase="x",le="+Inf"} 1' in text
+    assert 'cylon_lat_ms_count{phase="x"} 1' in text
+    assert text.endswith("\n")
+
+
+def test_exchange_feeds_shuffle_counters(dist_ctx):
+    """The wired-in counters: a real exchange grows shuffle bytes, rows
+    exchanged and collective launches."""
+    import cylon_tpu as ct
+    from cylon_tpu import telemetry
+
+    def series(name):
+        return telemetry.metrics_snapshot().get(name, 0)
+
+    b0 = series("cylon_shuffle_bytes_total")
+    r0 = series("cylon_rows_exchanged_total")
+    l0 = series("cylon_collective_launches_total")
+    t = ct.Table.from_pydict(dist_ctx, {"k": np.arange(256) % 16,
+                                        "v": np.arange(256.0)})
+    from cylon_tpu.parallel import dist_ops
+
+    dist_ops.shuffle(t, ["k"])
+    assert series("cylon_shuffle_bytes_total") > b0
+    assert series("cylon_rows_exchanged_total") >= r0 + 256
+    assert series("cylon_collective_launches_total") > l0
