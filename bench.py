@@ -25,12 +25,25 @@ published reference numbers (BASELINE.md:26-28).
 from __future__ import annotations
 
 import json
+import math
 import time
 
 import numpy as np
 
 # Cylon-MPI, 1 worker: 200M-row inner join in 141.5 s (BASELINE.md)
 _BASELINE_ROWS_PER_S = 200e6 / 141.5
+
+
+def _sig(x, sig: int = 6):
+    """Round floats to significant digits, not decimal places — a
+    sub-millisecond wall must stay nonzero and self-consistent with
+    the rate computed from it (BENCH_r05 reported wall_s_best 0.0
+    beside a 2.8M rows/s local-join rate). Local copy of
+    benchutils.round_sig: the armored driver parent must stay
+    importable without jax."""
+    if not isinstance(x, float) or x == 0.0 or not math.isfinite(x):
+        return x
+    return round(x, sig - 1 - int(math.floor(math.log10(abs(x)))))
 
 
 def _sync(t):
@@ -112,7 +125,7 @@ def bench_local_join(ctx, n_rows: int, iters: int) -> dict:
     total_rows = 2 * n_rows
     return {
         "rows_per_s_per_chip": total_rows / best,
-        "wall_s_best": round(best, 4),
+        "wall_s_best": _sig(best),
         "out_rows": out["t"].row_count,
     }
 
@@ -138,7 +151,7 @@ def bench_dist_join(ctx, n_rows: int, iters: int) -> dict:
     world = max(ctx.get_world_size(), 1)
     return {
         "rows_per_s_per_chip": 2 * n_rows / best / world,
-        "wall_s_best": round(best, 4),
+        "wall_s_best": _sig(best),
         "out_rows": out["t"].row_count,
     }
 
@@ -175,9 +188,9 @@ def bench_shuffle(ctx, n_rows: int, iters: int) -> dict:
 
     best = _time(one, iters)
     gbps = n_rows * bytes_per_row / best / 1e9 / world
-    return {"gbps_per_chip": round(gbps, 3),
+    return {"gbps_per_chip": _sig(gbps, 4),
             "rows_per_s_per_chip": n_rows / best / world,
-            "wall_s_best": round(best, 4)}
+            "wall_s_best": _sig(best)}
 
 
 def bench_shuffle_wide(ctx, n_rows: int, iters: int) -> dict:
@@ -215,10 +228,10 @@ def bench_shuffle_wide(ctx, n_rows: int, iters: int) -> dict:
 
     best = _time(one, iters)
     gbps = n_rows * bytes_per_row / best / 1e9 / world
-    return {"gbps_per_chip": round(gbps, 3),
+    return {"gbps_per_chip": _sig(gbps, 4),
             "bytes_per_row": bytes_per_row,
             "rows_per_s_per_chip": n_rows / best / world,
-            "wall_s_best": round(best, 4)}
+            "wall_s_best": _sig(best)}
 
 
 def bench_groupby(ctx, n_rows: int, iters: int) -> dict:
@@ -238,7 +251,7 @@ def bench_groupby(ctx, n_rows: int, iters: int) -> dict:
     best = _time(one, iters)
     world = max(ctx.get_world_size(), 1)
     return {"rows_per_s_per_chip": n_rows / best / world,
-            "wall_s_best": round(best, 4)}
+            "wall_s_best": _sig(best)}
 
 
 def bench_sort(ctx, n_rows: int, iters: int) -> dict:
@@ -258,7 +271,7 @@ def bench_sort(ctx, n_rows: int, iters: int) -> dict:
     best = _time(one, iters)
     world = max(ctx.get_world_size(), 1)
     return {"rows_per_s_per_chip": n_rows / best / world,
-            "wall_s_best": round(best, 4)}
+            "wall_s_best": _sig(best)}
 
 
 def bench_setops(ctx, n_rows: int, iters: int) -> dict:
@@ -282,7 +295,7 @@ def bench_setops(ctx, n_rows: int, iters: int) -> dict:
     best = _time(one, iters)
     world = max(ctx.get_world_size(), 1)
     return {"rows_per_s_per_chip": 2 * n_rows / best / world,
-            "wall_s_best": round(best, 4)}
+            "wall_s_best": _sig(best)}
 
 
 def bench_dist_union(ctx, n_rows: int, iters: int) -> dict:
@@ -311,7 +324,7 @@ def bench_dist_union(ctx, n_rows: int, iters: int) -> dict:
     best = _time(one, iters)
     world = max(ctx.get_world_size(), 1)
     return {"rows_per_s_per_chip": 2 * n_rows / best / world,
-            "wall_s_best": round(best, 4)}
+            "wall_s_best": _sig(best)}
 
 
 def bench_string_join(ctx, n_rows: int, iters: int) -> dict:
@@ -350,7 +363,7 @@ def bench_string_join(ctx, n_rows: int, iters: int) -> dict:
 
     best = _time(one, iters)
     return {"rows_per_s_per_chip": 2 * n_rows / best,
-            "wall_s_best": round(best, 4)}
+            "wall_s_best": _sig(best)}
 
 
 def bench_dist_sort(ctx, n_rows: int, iters: int) -> dict:
@@ -375,7 +388,7 @@ def bench_dist_sort(ctx, n_rows: int, iters: int) -> dict:
     best = _time(one, iters)
     world = max(ctx.get_world_size(), 1)
     return {"rows_per_s_per_chip": n_rows / best / world,
-            "wall_s_best": round(best, 4)}
+            "wall_s_best": _sig(best)}
 
 
 def bench_dist_string_join(ctx, n_rows: int, iters: int) -> dict:
@@ -420,7 +433,7 @@ def bench_dist_string_join(ctx, n_rows: int, iters: int) -> dict:
     best = _time(one, iters)
     world = max(ctx.get_world_size(), 1)
     return {"rows_per_s_per_chip": 2 * n_rows / best / world,
-            "wall_s_best": round(best, 4),
+            "wall_s_best": _sig(best),
             "out_rows": out["t"].row_count}
 
 
@@ -497,11 +510,11 @@ def bench_plan_pipeline(ctx, n_rows: int, iters: int) -> dict:
     total = 2 * n_rows
     return {
         "world": world,
-        "eager_wall_s_best": round(eager_s, 4),
-        "plan_wall_s_best": round(plan_s, 4),
+        "eager_wall_s_best": _sig(eager_s),
+        "plan_wall_s_best": _sig(plan_s),
         "eager_shuffles": int(eager_shuffles),
         "plan_shuffles": int(plan_shuffles),
-        "speedup": round(eager_s / plan_s, 3) if plan_s else 0.0,
+        "speedup": _sig(eager_s / plan_s, 4) if plan_s else 0.0,
         "eager_rows_per_s_per_chip": total / eager_s / world,
         "plan_rows_per_s_per_chip": total / plan_s / world,
         "plan_report": plan_report,
@@ -532,14 +545,21 @@ def bench_pandas_reference(n_rows: int, iters: int = 1) -> dict:
     group_s = _time(lambda: gdf.groupby("g").agg(
         s=("x", "sum"), c=("x", "count"), m=("x", "mean")), iters)
     return {"join_rows_per_s": 2 * n_rows / join_s,
-            "join_s": round(join_s, 4),
+            "join_s": _sig(join_s),
             "groupby_rows_per_s": n_rows / group_s,
-            "groupby_s": round(group_s, 4)}
+            "groupby_s": _sig(group_s)}
 
 
 def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
     import jax
 
+    # compile-cost capture for every kernel factory the run builds:
+    # enabled BEFORE the context (and so before any counted_cache memo
+    # fills) — the artifact then carries per-factory compile seconds +
+    # XLA cost analysis beside the wall-clock numbers
+    from cylon_tpu.telemetry import profiler as _profiler
+
+    _profiler.enable()
     ctx = _mk_ctx()
     dist_res = bench_dist_join(ctx, n_rows, iters)
     local_res = bench_local_join(ctx, n_rows, iters)
@@ -597,11 +617,12 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
             "out_rows": dist_res["out_rows"],
             "backend": jax.devices()[0].platform,
             "local_inner_join": {
-                k: (round(v, 1) if isinstance(v, float) else v)
+                k: (_sig(v) if isinstance(v, float) else v)
                 for k, v in local_res.items()},
             "shuffle_gbps": shuffle_res["gbps_per_chip"],
             "shuffle": shuffle_res,
-            "suite": {k: {kk: (round(vv, 4) if isinstance(vv, float) else vv)
+            "compile_profile": _profiler.summary(),
+            "suite": {k: {kk: (_sig(vv) if isinstance(vv, float) else vv)
                           for kk, vv in v.items()}
                       for k, v in suite.items()},
         },
@@ -662,7 +683,7 @@ def bench_hbm_blocked_join(ctx, n_probe: int, n_build: int) -> dict:
         # a rows/s number for the blocked path only counts if the
         # blocked path actually ran — otherwise report the miss loudly
         "rows_per_s_per_chip": round(total / wall, 1) if blocked else 0.0,
-        "wall_s": round(wall, 4), "out_rows": int(rows),
+        "wall_s": _sig(wall), "out_rows": int(rows),
         "probe_rows": n_probe, "build_rows": n_build,
         "blocked_engaged": blocked, "forced": forced,
         "working_set_gb": round((n_probe + n_build) * 8 * 8 / 1e9, 2)}
@@ -708,7 +729,7 @@ def bench_q5_pipeline(ctx, n_rows: int, iters: int) -> dict:
     # rows ingested across the pipeline
     total = n_cust + n_rows // 4 + n_rows
     return {"rows_per_s_per_chip": total / best / world,
-            "wall_s_best": round(best, 4)}
+            "wall_s_best": _sig(best)}
 
 
 def cpu_fallback(n_rows: int = 1 << 16, iters: int = 1) -> dict:

@@ -26,11 +26,18 @@ needed), traces it with abstract `ShapeDtypeStruct` inputs via
 
 Entry points are DECLARED (factory + static args + input shapes) in
 ``default_entry_points`` — abstract evaluation needs concrete static
-configuration. The checker emits a note listing any ``_*_fn`` factory
-in `parallel/` that the catalog does not cover, so catalog drift is
-visible in every run instead of rotting silently. The Pallas stream
-factories are TPU-only (the interpreter inside jit is prohibitive) and
-are skipped with a note off-TPU.
+configuration. Any ``_*_fn`` factory in `parallel/` the catalog does
+not cover is a REAL FINDING (``collectives/uncataloged-factory``), not
+a note: an uncataloged factory is a collective program no axis-name /
+all-to-all / f64 check ever sees, which is exactly how catalog drift
+used to rot. Helpers that merely LOOK like factories (returning plain
+host callables, not jitted programs) opt out explicitly with
+``# cylint: disable=collectives/uncataloged-factory`` on their def
+line — exclusion is a reviewable decision, never a hidden set. The
+Pallas stream factories are TPU-only (the interpreter inside jit is
+prohibitive) and are skipped with a note off-TPU. Option
+``collectives_coverage_only`` runs just the catalog sweep (no tracing)
+— the fast form the fixture tests drive.
 """
 from __future__ import annotations
 
@@ -378,6 +385,10 @@ def check_collectives(ctx: AnalysisContext) -> List[Finding]:
     entry_module = ctx.options.get("collectives_entry_module")
     if entry_module is None and ctx.options.get("skip_collectives"):
         return []
+    if ctx.options.get("collectives_coverage_only"):
+        covered = {(e.path, e.factory)
+                   for e in default_entry_points() if e.factory}
+        return _coverage_findings(ctx, covered)
     import jax
 
     # f64-promotion detection needs x64 on: with it off, jax silently
@@ -418,7 +429,7 @@ def check_collectives(ctx: AnalysisContext) -> List[Finding]:
                 continue
             findings.extend(_check_jaxpr(closed.jaxpr, e, line))
         if entry_module is None:
-            notes.extend(_coverage_note(ctx, covered))
+            findings.extend(_coverage_findings(ctx, covered))
         return findings
     finally:
         if not x64_before:
@@ -439,17 +450,15 @@ def _factory_line(ctx: AnalysisContext, e: EntryPoint) -> int:
     return 1
 
 
-# _*_fn helpers that are NOT jitted-program factories (they return
-# plain host-side callables) — excluded from the coverage sweep
-_NOT_KERNEL_FACTORIES = {("parallel/shuffle.py", "_to_varying_fn")}
-
-
-def _coverage_note(ctx: AnalysisContext, covered) -> List[str]:
-    """List `_*_fn` kernel factories the catalog misses — drift is
-    reported every run, never silently."""
+def _coverage_findings(ctx: AnalysisContext, covered) -> List[Finding]:
+    """One ``collectives/uncataloged-factory`` finding per `_*_fn` in
+    `parallel/` the entry-point catalog misses. Intentional exclusions
+    (helpers returning plain host callables rather than jitted
+    programs) carry a per-line ``# cylint: disable=`` — suppression
+    counting keeps them visible in the run summary."""
     import ast
 
-    missing = []
+    findings: List[Finding] = []
     for f in ctx.files():
         if not f.rel.startswith("parallel/"):
             continue
@@ -457,10 +466,14 @@ def _coverage_note(ctx: AnalysisContext, covered) -> List[str]:
             if isinstance(node, ast.FunctionDef) and \
                     node.name.startswith("_") and \
                     node.name.endswith("_fn") and \
-                    (f.rel, node.name) not in covered and \
-                    (f.rel, node.name) not in _NOT_KERNEL_FACTORIES:
-                missing.append(f"{f.rel}:{node.name}")
-    if not missing:
-        return []
-    return [f"collectives: kernel factories not in the entry-point "
-            f"catalog (add them): {', '.join(sorted(missing))}"]
+                    (f.rel, node.name) not in covered:
+                findings.append(Finding(
+                    rule="collectives/uncataloged-factory", path=f.rel,
+                    line=node.lineno,
+                    message=f"{node.name} is not in the collectives "
+                            f"entry-point catalog: its collective "
+                            f"program is never abstractly checked — "
+                            f"add an EntryPoint (or disable this rule "
+                            f"on the def line if it returns a plain "
+                            f"host callable)"))
+    return findings

@@ -10,12 +10,27 @@ trusting the wall clock around a dispatch.
 """
 from __future__ import annotations
 
+import math
 import time
 from typing import Sequence
 
 import numpy as np
 
 _DIV = {"ns": 1.0, "us": 1e3, "ms": 1e6, "s": 1e9}
+
+
+def round_sig(x: float, sig: int = 6) -> float:
+    """Round to ``sig`` SIGNIFICANT digits (not decimal places).
+
+    Fixed-decimal rounding destroyed sub-millisecond bench walls —
+    BENCH_r05 reported ``local_inner_join.wall_s_best: 0.0`` beside a
+    2.8M rows/s rate because a 23 ms wall was rounded to 1 decimal.
+    Significant-digit rounding keeps any nonzero measurement nonzero
+    and self-consistent with the rates computed from the unrounded
+    value, at any scale."""
+    if not isinstance(x, float) or x == 0.0 or not math.isfinite(x):
+        return x
+    return round(x, sig - 1 - int(math.floor(math.log10(abs(x)))))
 
 
 def _force(value) -> None:
@@ -49,11 +64,14 @@ def benchmark_with_repetitions(repetitions: int = 10, time_type: str = "ms"):
 
     def wrap(f):
         def wrapped_f(*args, **kwargs):
-            t1 = time.time_ns()
+            # perf_counter_ns: monotonic, full resolution — a wall-clock
+            # (time_ns) step mid-run would corrupt the measurement, and
+            # rates must derive from the unrounded integer-ns wall
+            t1 = time.perf_counter_ns()
             for _ in range(repetitions):
                 rets = f(*args, **kwargs)
                 _force(rets)
-            t2 = time.time_ns()
+            t2 = time.perf_counter_ns()
             return (t2 - t1) / div / float(repetitions), rets
 
         return wrapped_f

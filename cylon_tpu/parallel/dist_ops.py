@@ -47,7 +47,7 @@ from ..ops import order as _order
 from ..ops import setops as _setops
 from ..status import Code, CylonError
 from ..telemetry import annotate as _annotate, counted_cache, \
-    phase as _phase, span as _span
+    phase as _phase, record_host_sync as _host_sync, span as _span
 from . import shard
 from ..util import capacity as _capacity
 from .shuffle import count_pair, exchange, exchange_pair, \
@@ -458,6 +458,7 @@ def _varlen_take_sharded(ctx: CylonContext, vb, idx) -> "object":
     idx = shard.pin(idx, ctx)
     counts = np.asarray(jax.device_get(
         _varlen_count_fn(ctx.mesh)(lengths, idx)))
+    _host_sync("varlen.count")
     cap_w = _capacity(max(int(counts.max()), 1))
     w, s, ln = _varlen_take_fn(ctx.mesh, cap_w)(words, starts, lengths, idx)
     world = ctx.get_world_size()
@@ -479,6 +480,7 @@ def _dist_as_varbytes(ctx: CylonContext, col: Column) -> Column:
     counts = np.asarray(jax.device_get(
         _varlen_count_fn(ctx.mesh, replicated=True)(
             jax.device_put(vocab_vb.lengths), codes)))
+    _host_sync("varlen.count")
     cap_w = _capacity(max(int(counts.max()), 1))
     w, s, ln = _varlen_take_fn(ctx.mesh, cap_w, replicated=True)(
         vocab_vb.words, vocab_vb.starts, vocab_vb.lengths, codes)
@@ -815,6 +817,7 @@ def hash_partition(table: Table, hash_columns: Sequence,
     counts = np.asarray(jax.device_get(jax.ops.segment_sum(
         jnp.ones(tkey.shape[0], jnp.int32), tkey,
         num_segments=num_partitions + 1)))[:num_partitions]
+    _host_sync("hash_partition.counts")
     offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
 
     out = {}
@@ -1003,6 +1006,7 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
                     lkb, lkv, lemit, rkb, rkv, remit,
                     ldat, lval, rdat, rval)
             cm = np.asarray(jax.device_get(rep_counts)).reshape(world, -1)
+            _host_sync("join.plan")
         if not (hash_mode and int(cm[:, 3].sum()) > 0):
             cap_e = _join.stream_expand_capacity(int(cm[:, 0].max()), br)
             with _phase("distributed_join.materialize", seq):
@@ -1023,6 +1027,7 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
             # [n_primary, n_unmatched_b]; capacity = worst shard (all
             # shards share one program)
             counts = np.asarray(jax.device_get(counts2)).reshape(world, 2)
+            _host_sync("join.plan")
             _annotate(rows_out=int(counts[:, 0].sum()))
         cap_p = _capacity(int(counts[:, 0].max()))
         cap_u = _capacity(int(counts[:, 1].max())) \
@@ -1099,7 +1104,9 @@ def _exact_post_verify(res: Table, nl: int, pairs, config):
         bad = bad | (emit & both & ~a.varbytes.equals_rows(b.varbytes))
     if config.type == _join.JoinType.INNER:
         return Table(res._columns, res._ctx, emit & ~bad), False
-    return res, bool(jax.device_get(bad.any()))
+    collided = bool(jax.device_get(bad.any()))
+    _host_sync("join.exact_verify")
+    return res, collided
 
 
 def _exact_dict_redo(left: Table, right: Table, config: _join.JoinConfig,
@@ -1364,6 +1371,7 @@ def distributed_join_ring(left: Table, right: Table,
         counts = np.asarray(jax.device_get(_ring_count_fn(
             ctx.mesh, emit_un_a, len(abits))(
             abits, akv, aemit, bbits, bkv, bemit)))
+        _host_sync("ring.count")
     pairs, extra = counts[:, :world], counts[:, world]
     cap_step = _capacity(int(pairs.max())) if pairs.size else 1
     cap_extra = _capacity(int(extra.max())) if emit_un_a else 0
@@ -1512,6 +1520,7 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp,
     with _phase("distributed_set_op.count", seq):
         counts = np.asarray(jax.device_get(_setop_count_fn(ctx.mesh)(
             lkb, lemit, rkb, remit))).reshape(world, 3)
+        _host_sync("setop.count")
     total = counts[:, int(op)]
     cap = _capacity(int(total.max()))
 
@@ -1529,6 +1538,7 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp,
                 _varlen_take_concat_count_fn(ctx.mesh)(
                     shard.pin(a.varbytes.lengths, ctx),
                     shard.pin(bvb.lengths, ctx), idx)))
+            _host_sync("varlen.count")
             cap_w = _capacity(max(int(wcounts.max()), 1))
             w, s, ln = _varlen_take_concat_fn(ctx.mesh, cap_w)(
                 shard.pin(a.varbytes.words, ctx),
@@ -1825,6 +1835,7 @@ def _range_splitters(ctx: CylonContext, lanes, emit):
         [jnp.take(l, pos).astype(wide) for l in lanes]
         + [jnp.take(emit, pos).astype(wide)])
     host = np.asarray(jax.device_get(packed))
+    _host_sync("sort.splitters")
     live = host[-1].astype(bool)
     samples = [host[i].astype(l.dtype)[live]
                for i, l in enumerate(lanes)]

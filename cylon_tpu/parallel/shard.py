@@ -27,6 +27,7 @@ from ..context import CylonContext
 from ..data.column import Column
 from ..data.table import Table
 from ..status import Code, CylonError
+from ..telemetry import record_host_sync as _host_sync
 
 # Per-shard capacities are rounded to a multiple of 8 (TPU sublane quantum)
 _ROW_QUANTUM = 8
@@ -129,6 +130,7 @@ def _distribute_varbytes(c: Column, n: int, cap: int, world: int,
     words_h = np.asarray(jax.device_get(vb.words))
     starts_h = np.asarray(jax.device_get(vb.eff_starts()))
     lens_h = np.asarray(jax.device_get(vb.lengths))
+    _host_sync("distribute.varbytes", 3)
     nw_h = (lens_h.astype(np.int64) + 3) // 4
     slices = []
     for s in range(world):
@@ -209,6 +211,8 @@ def host_partition_arrays(t: Table, idxs, world: int):
     valids = [None if c.validity is None
               else np.asarray(jax.device_get(c.valid_mask()))
               for c in t._columns]
+    _host_sync("ingest.host_partition",
+               len(host) + sum(v is not None for v in valids))
     keys = []
     pre = []
     for i in idxs:

@@ -48,7 +48,8 @@ except ImportError:  # pragma: no cover
 
 from ..context import CylonContext
 from ..telemetry import counted_cache, counter as _counter, \
-    phase as _phase, span as _span
+    phase as _phase, record_host_sync as _host_sync, span as _span
+from ..telemetry import skew as _skew
 from ..util import pow2 as _pow2
 
 # Upper bound on the per-round block (rows per (src,dst) pair per round).
@@ -92,6 +93,13 @@ def _record_exchange(rows: int, nbytes: int, programs: int = 1) -> None:
     _counter("cylon_collective_launches_total").inc(programs)
 
 
+def _payload_row_bytes(payload) -> int:
+    """Host-computable bytes per ROW of a payload pytree — the
+    per-shard byte-histogram feed (skew.observe_exchange)."""
+    return sum(int(np.dtype(x.dtype).itemsize) * int(np.prod(x.shape[1:]))
+               for x in jax.tree.leaves(payload))
+
+
 # beyond this world size, per-target compare-sum passes cost more than
 # one scatter-class segment_sum
 _COUNT_COMPARE_MAX_W = 64
@@ -129,7 +137,7 @@ def _count_fn(mesh):
                              out_specs=P()))
 
 
-def _to_varying_fn(axis):
+def _to_varying_fn(axis):  # cylint: disable=collectives/uncataloged-factory — returns a plain host callable, not a jitted program
     _vary = getattr(jax.lax, "pcast", None)
     if _vary is not None:
         return lambda x: jax.lax.pcast(x, axis, to="varying")
@@ -333,8 +341,18 @@ def exchange_pair(payload1, targets1, emit1, counts1,
         rows = (int(counts1.sum()) if counts1 is not None else 0) \
             + (int(counts2.sum()) if counts2 is not None else 0)
         nbytes = _payload_nbytes(payload1) + _payload_nbytes(payload2)
+        # per-side histograms carry each table's own row width; the
+        # span attributes carry the COMBINED per-destination totals
+        # (what each shard actually absorbs from the fused program)
+        _skew.observe_exchange(counts1, _payload_row_bytes(payload1))
+        _skew.observe_exchange(counts2, _payload_row_bytes(payload2))
+        pair_stats = _skew.SkewStats.from_counts(
+            np.asarray(counts1) + np.asarray(counts2)) \
+            if counts1 is not None and counts2 is not None else None
         with _span("shuffle.exchange_pair", seq, world=world,
-                   mode="padded", rows=rows, bytes_moved=nbytes):
+                   mode="padded", rows=rows, bytes_moved=nbytes) as sp:
+            if pair_stats is not None:
+                sp.set(**pair_stats.span_attrs())
             res = _exchange_padded_pair_fn(ctx.mesh, b1, b2)(
                 payload1, targets1, emit1, payload2, targets2, emit2)
         _record_exchange(rows, nbytes)
@@ -470,6 +488,7 @@ def count_pair(targets1, emit1, targets2, emit2, ctx: CylonContext):
                    world=ctx.get_world_size(), tables=2):
             both = np.asarray(jax.device_get(
                 _count2_fn(ctx.mesh)(targets1, emit1, targets2, emit2)))
+        _host_sync("shuffle.count_pair")
         _counter("cylon_collective_launches_total").inc()
         return both[:, 0, :], both[:, 1, :]
 
@@ -567,6 +586,7 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
             with _span("shuffle.count", seq, world=world, tables=1):
                 res = np.asarray(jax.device_get(
                     _count_fn(ctx.mesh)(targets, emit)))
+            _host_sync("shuffle.count")
             _counter("cylon_collective_launches_total").inc()
             return res
 
@@ -583,9 +603,14 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
     cap_compact = _pow2(recv_max)
     rows_live = int(counts.sum()) if counts.size else 0
     nbytes = _payload_nbytes(payload)
+    # skew observability rides the ALREADY-FETCHED count matrix: zero
+    # extra device→host transfers (None on a 1-wide mesh)
+    skew_stats = _skew.observe_exchange(counts, _payload_row_bytes(payload))
     with _span("shuffle.exchange", seq, world=world,
                mode="padded" if padded_ok else "compact",
                rows=rows_live, bytes_moved=nbytes) as sp:
+        if skew_stats is not None:
+            sp.set(**skew_stats.span_attrs())
         if padded_ok:
             out, new_emit, counts_in = _exchange_padded_fn(
                 ctx.mesh, block_p)(payload, targets, emit)

@@ -71,7 +71,8 @@ def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None
         else None
     memory = telemetry.sample_memory(pool) if pool is not None else {}
     report = PlanReport(
-        root=build_measures(plan, ex._recorder.recs, cp.labels),
+        root=build_measures(plan, ex._recorder.recs, cp.labels,
+                            spans=cp.spans),
         span=root_span,
         shuffle_count=cp.count("plan.shuffle"),
         total_ms=root_span.elapsed_ms,

@@ -21,6 +21,13 @@ shapes those measurements into a `PlanReport`:
 definitionally equal to ``collect_phases.count("plan.shuffle")`` over
 the same execution — both read the same label stream.
 
+Skew columns: exchange spans (``shuffle.exchange*``) carry the
+per-shard skew attributes telemetry/skew.py computed from the count
+matrix; each node's OWN exchange spans fold into a per-node ``skew``
+summary rendered as ``skew(imb=… rows/shard min/med/max=…)``, with a
+``[SKEW]`` marker once the imbalance crosses the configurable warning
+threshold (``CYLON_SKEW_WARN_FACTOR``, default 2.0).
+
 Time semantics: ``ms`` is INCLUSIVE of children (Postgres "actual
 time"); host-visible wall clock, so async dispatch cost unless the
 node ends in a host sync (see telemetry docstring). Rows are LIVE rows
@@ -55,6 +62,7 @@ class NodeMeasure:
     bytes: Optional[int] = None    # output device bytes (Table.nbytes)
     labels: List[str] = field(default_factory=list)  # own labels only
     children: List["NodeMeasure"] = field(default_factory=list)
+    skew: Optional[dict] = None    # worst own-exchange skew (see below)
 
     @property
     def shuffles(self) -> int:
@@ -65,9 +73,16 @@ class NodeMeasure:
             if self.partitioned_by is not None else ""
         if not self.executed:
             return f"{self.desc}{pb}  (folded into parent exchange)"
+        sk = ""
+        if self.skew is not None:
+            warn = "  [SKEW]" if self.skew["warn"] else ""
+            sk = (f", skew(imb={self.skew['imbalance']:.2f} rows/shard "
+                  f"min/med/max={self.skew['rows_min']}/"
+                  f"{self.skew['rows_med']}/{self.skew['rows_max']})"
+                  f"{warn}")
         return (f"{self.desc}{pb}  (actual time={self.ms:.2f} ms, "
                 f"rows={self.rows}, bytes={_human_bytes(self.bytes)}, "
-                f"shuffles={self.shuffles})")
+                f"shuffles={self.shuffles}{sk})")
 
     def to_dict(self) -> dict:
         return {
@@ -78,20 +93,51 @@ class NodeMeasure:
             "ms": round(self.ms, 3) if self.ms is not None else None,
             "rows": self.rows, "bytes": self.bytes,
             "shuffles": self.shuffles, "labels": list(self.labels),
+            "skew": dict(self.skew) if self.skew is not None else None,
             "children": [c.to_dict() for c in self.children],
         }
 
 
+def _fold_skew(spans) -> Optional[dict]:
+    """The WORST skew over a node's own exchange spans (by imbalance),
+    plus the count of exchanges that carried skew attributes — one
+    summary per node, however many physical exchanges its lowering
+    dispatched (a fused join pair is one span; groupby phase A/B are
+    two)."""
+    worst = None
+    n = 0
+    for s in spans:
+        a = getattr(s, "attrs", {})
+        if "skew_imbalance" not in a:
+            continue
+        n += 1
+        if worst is None or a["skew_imbalance"] > worst["skew_imbalance"]:
+            worst = a
+    if worst is None:
+        return None
+    return {"imbalance": float(worst["skew_imbalance"]),
+            "rows_min": int(worst["shard_rows_min"]),
+            "rows_med": int(worst["shard_rows_med"]),
+            "rows_max": int(worst["shard_rows_max"]),
+            "warn": bool(worst["skew_warn"]),
+            "exchanges": n}
+
+
 def build_measures(node: ir.PlanNode, recs: Dict[int, object],
-                   labels: List[str]) -> NodeMeasure:
+                   labels: List[str],
+                   spans: Optional[List[object]] = None) -> NodeMeasure:
     """Shape the executor's per-node records into a NodeMeasure tree.
 
     ``recs`` maps id(plan node) -> record with (i0, i1, ms, rows,
     nbytes) where [i0, i1) indexes ``labels``. A node's OWN labels are
     its inclusive range minus every executed descendant's range —
     grandchildren under a folded (unexecuted) Shuffle still subtract
-    from the folding join's range."""
-    children = [build_measures(c, recs, labels) for c in node.children]
+    from the folding join's range. ``spans`` is the collector's Span
+    list, index-aligned with ``labels`` (collect_phases appends both
+    per entered span); the node's own ``shuffle.exchange*`` spans fold
+    into its ``skew`` summary."""
+    children = [build_measures(c, recs, labels, spans)
+                for c in node.children]
     r = recs.get(id(node))
     base = dict(kind=node.kind,
                 desc=f"{type(node).__name__}({node.args_repr()})",
@@ -107,9 +153,15 @@ def build_measures(node: ir.PlanNode, recs: Dict[int, object],
             continue
         for i in range(max(dr.i0, r.i0), min(dr.i1, r.i1)):
             covered[i - r.i0] = True
-    own = [labels[i] for i in range(r.i0, r.i1) if not covered[i - r.i0]]
+    own_idx = [i for i in range(r.i0, r.i1) if not covered[i - r.i0]]
+    own = [labels[i] for i in own_idx]
+    skew = None
+    if spans is not None:
+        skew = _fold_skew(
+            [spans[i] for i in own_idx
+             if spans[i].name.startswith("shuffle.exchange")])
     return NodeMeasure(executed=True, ms=r.ms, rows=r.rows,
-                       bytes=r.nbytes, labels=own, **base)
+                       bytes=r.nbytes, labels=own, skew=skew, **base)
 
 
 @dataclass

@@ -18,6 +18,13 @@ and grows it into a measurement layer:
 * ``export``  — JSONL span sink and Prometheus text dump; the
   ``jax.profiler.TraceAnnotation`` carrier stays inside ``span`` so
   Perfetto labels work with no exporter configured.
+* ``skew``    — key-distribution skew stats reduced from the exchange
+  count matrices the host already fetches (zero extra syncs): per-shard
+  send/recv rows+bytes histograms, imbalance factor, EXPLAIN ANALYZE
+  warning threshold.
+* ``profiler`` — opt-in kernel compile-cost capture hooked into
+  ``counted_cache``: compile wall time + XLA cost analysis per factory
+  program (``cylon_kernel_compile_seconds{factory=...}``).
 
 The plan executor builds per-query EXPLAIN ANALYZE reports
 (plan/report.py) on this layer; docs/telemetry.md documents the span
@@ -35,8 +42,11 @@ from .spans import (Span, annotate, collect_phases, current_span,
                     remove_sink)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       REGISTRY, counted_cache, counter, gauge, histogram,
-                      metrics_snapshot, reset_metrics, sample_memory)
+                      metrics_snapshot, record_host_sync, reset_metrics,
+                      sample_memory)
 from .export import JsonlSpanSink, prometheus_text, span_to_json
+from . import profiler, skew
+from .skew import SkewStats
 
 __all__ = [
     # spans
@@ -45,7 +55,9 @@ __all__ = [
     # metrics
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "counted_cache", "counter", "gauge", "histogram", "metrics_snapshot",
-    "reset_metrics", "sample_memory",
+    "record_host_sync", "reset_metrics", "sample_memory",
     # exporters
     "JsonlSpanSink", "prometheus_text", "span_to_json",
+    # skew + compile-cost observability
+    "profiler", "skew", "SkewStats",
 ]

@@ -203,6 +203,31 @@ def observe_phase(name: str, elapsed_ms: float, error: bool = False
                          {"phase": name}).inc()
 
 
+def record_host_sync(site: str, n: int = 1) -> None:
+    """One device→host round trip at a named choke point — feeds
+    ``cylon_host_syncs_total{site=...}``. The sites are the
+    ``jax.device_get`` calls the hostsync analysis already classifies
+    as host-side-legal (count fetches, splitter samples, plan-capacity
+    reads); this counter makes the round trips per query VISIBLE (each
+    one costs ~100 ms through the axon tunnel). ``site`` labels must be
+    static strings at the call site — label cardinality is the fixed
+    set of choke points, never data."""
+    REGISTRY.counter("cylon_host_syncs_total", {"site": site}).inc(n)
+
+
+# Build hook for the compile-cost profiler (telemetry/profiler.py):
+# when installed, every counted_cache factory build passes its result
+# through ``hook(factory_name, built)`` so the profiler can wrap the
+# jitted program with compile-time capture. Kept as a late-bound module
+# attribute so metrics (a leaf of the leaf) never imports profiler.
+_factory_build_hook: Optional[Callable] = None
+
+
+def set_factory_build_hook(hook: Optional[Callable]) -> None:
+    global _factory_build_hook
+    _factory_build_hook = hook
+
+
 def counted_cache(fn: Callable) -> Callable:
     """``lru_cache(maxsize=None)`` plus a build counter — the drop-in
     decorator for the jit kernel-factory memo layer. Every cache miss
@@ -215,7 +240,11 @@ def counted_cache(fn: Callable) -> Callable:
 
     def _build(*args, **kwargs):
         c.inc()
-        return fn(*args, **kwargs)
+        out = fn(*args, **kwargs)
+        hook = _factory_build_hook
+        if hook is not None:
+            out = hook(fn.__name__, out)
+        return out
 
     cached = functools.lru_cache(maxsize=None)(_build)
     try:

@@ -51,8 +51,10 @@ from . import metrics as _metrics
 logger = logging.getLogger("cylon_tpu")
 
 # active phase collectors (collect_phases contexts) — every entered
-# span appends its label to each, so callers can COUNT events (e.g. a
-# query plan's shuffles) without wiring a logging handler
+# span appends its label AND the Span object to each, so callers can
+# COUNT events (e.g. a query plan's shuffles) without wiring a logging
+# handler, and the plan recorder can read back typed attributes (the
+# exchange skew stats) by the same indices
 _collectors: list = []
 
 # completed-span sinks (add_sink/remove_sink); each is called with every
@@ -143,20 +145,25 @@ class collect_phases:
     """Collect every span label entered inside the context — the
     programmatic mirror of the INFO log stream. ``count(prefix)``
     answers questions like "how many shuffles did this plan run?"
-    (prefix="plan.shuffle"); labels keep their ``name#seq`` form."""
+    (prefix="plan.shuffle"); labels keep their ``name#seq`` form.
+    ``spans[i]`` is the Span whose label is ``labels[i]`` — attributes
+    set later in the span body (skew stats, rows_out) are visible
+    after it closes, which is how the EXPLAIN ANALYZE recorder reads
+    per-exchange skew without re-threading the objects."""
 
     def __init__(self):
         self.labels: list = []
+        self.spans: list = []
 
     def __enter__(self) -> "collect_phases":
-        _collectors.append(self.labels)
+        _collectors.append(self)
         return self
 
     def __exit__(self, *exc):
         # remove by IDENTITY: list.remove compares by ==, and two nested
-        # collectors with equal contents would remove each other's lists
-        for i, l in enumerate(_collectors):
-            if l is self.labels:
+        # collectors with equal contents would remove each other
+        for i, c in enumerate(_collectors):
+            if c is self:
                 del _collectors[i]
                 break
         return False
@@ -188,7 +195,8 @@ def span(name: str, seq: Optional[int] = None, **attrs) -> Iterator[Span]:
              parent_id=parent.span_id if parent is not None else 0)
     label = s.label
     for c in _collectors:
-        c.append(label)
+        c.labels.append(label)
+        c.spans.append(s)
     if parent is not None:
         parent.children.append(s)
     token = _current.set(s)

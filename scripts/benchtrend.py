@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Bench-trend regression gate over the committed BENCH_r*.json rounds.
+
+Five rounds of driver-verified artifacts sit in the repo and, until
+now, nothing read them: a perf regression could ship as long as the
+current round still *ran*. This tool turns the artifact trajectory
+into (a) a human trend table and (b) a CI gate:
+
+    python scripts/benchtrend.py            # render the trend table
+    python scripts/benchtrend.py --check    # exit 1 on a regression
+
+A "metric" is any higher-is-better rate the artifacts carry — the
+primary distributed-join throughput, shuffle GB/s, every suite
+config's rows/s, the plan-pipeline speedup. Artifacts are
+heterogeneous across rounds (early rounds predate the suite; one round
+is rc=1 with ``parsed: null``; outage rounds fall back to a CPU mesh),
+so extraction is tolerant: missing metrics are blanks in the table,
+unparsed rounds are listed and skipped.
+
+Regression semantics (``--check``): the LATEST parsed round is
+compared metric-by-metric against the MOST RECENT EARLIER round with
+the SAME backend — a CPU-fallback artifact is never judged against a
+TPU round (that "regression" is an outage, already visible in the
+artifact itself, not a code change). A metric regresses when
+
+    latest < (1 - threshold) * reference        (default threshold 0.2)
+
+Any regression prints the offending metrics and exits 1; no comparable
+earlier round exits 0 with a note. New metrics (no reference) and
+removed metrics (no latest) never fail the gate.
+
+Synthetic-trajectory unit tests: tests/test_benchtrend.py.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_THRESHOLD = 0.2
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_rounds(directory: str, pattern: str = "BENCH_r*.json"
+                ) -> List[dict]:
+    """[{round, path, parsed, backend}] sorted by round number; parsed
+    is None for rounds whose driver run produced no artifact JSON."""
+    rounds = []
+    for path in glob.glob(os.path.join(directory, pattern)):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            doc = json.load(open(path, encoding="utf-8"))
+        except (OSError, json.JSONDecodeError):
+            doc = {}
+        parsed = doc.get("parsed")
+        backend = None
+        if isinstance(parsed, dict):
+            backend = (parsed.get("detail") or {}).get("backend")
+        rounds.append({"round": int(m.group(1)), "path": path,
+                       "parsed": parsed if isinstance(parsed, dict)
+                       else None,
+                       "backend": backend})
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) and v > 0 else None
+
+
+def flatten_metrics(parsed: Optional[dict]) -> Dict[str, float]:
+    """Flat {metric: value} of every higher-is-better rate one
+    artifact carries. Suite configs that recorded an ``error`` (the
+    one-failing-config-doesn't-sink-the-artifact path) contribute
+    nothing."""
+    out: Dict[str, float] = {}
+    if not isinstance(parsed, dict):
+        return out
+    v = _num(parsed.get("value"))
+    if v is not None:
+        out["dist_inner_join.rows_per_s"] = v
+    det = parsed.get("detail") or {}
+    lj = det.get("local_inner_join") or {}
+    v = _num(lj.get("rows_per_s_per_chip"))
+    if v is not None:
+        out["local_inner_join.rows_per_s"] = v
+    v = _num(det.get("shuffle_gbps"))
+    if v is not None:
+        out["shuffle.gbps"] = v
+    for name, cfg in (det.get("suite") or {}).items():
+        if not isinstance(cfg, dict) or "error" in cfg:
+            continue
+        for src, suffix in (("rows_per_s_per_chip", "rows_per_s"),
+                            ("gbps_per_chip", "gbps"),
+                            ("speedup", "speedup"),
+                            ("join_rows_per_s", "join_rows_per_s"),
+                            ("groupby_rows_per_s", "groupby_rows_per_s")):
+            v = _num(cfg.get(src))
+            if v is not None:
+                out[f"{name}.{suffix}"] = v
+    return out
+
+
+def _human(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    for bound, suffix in ((1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= bound:
+            return f"{v / bound:.2f}{suffix}"
+    return f"{v:.3g}"
+
+
+def render_table(rounds: List[dict]) -> str:
+    """Metrics × rounds text table, plus the latest-vs-reference delta
+    column the --check gate judges."""
+    per_round = [(r, flatten_metrics(r["parsed"])) for r in rounds]
+    metrics = sorted({m for _r, f in per_round for m in f})
+    if not metrics:
+        return "benchtrend: no parseable BENCH artifacts"
+    ref = reference_round(rounds)
+    latest = latest_parsed(rounds)
+    flat_by_round = {r["round"]: f for r, f in per_round}
+    ref_flat = flat_by_round.get(ref["round"], {}) if ref else {}
+    latest_flat = flat_by_round.get(latest["round"], {}) if latest else {}
+    heads = ["metric"] + [f"r{r['round']:02d}" for r, _f in per_round] \
+        + ["Δ latest"]
+    body = []
+    for m in metrics:
+        row = [m] + [_human(f.get(m)) for _r, f in per_round]
+        a, b = ref_flat.get(m), latest_flat.get(m)
+        row.append(f"{(b - a) / a * 100:+.1f}%" if a and b else "-")
+        body.append(row)
+    widths = [max(len(h), *(len(r[i]) for r in body))
+              for i, h in enumerate(heads)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(heads, widths))]
+    lines.append("  ".join("-" * w for w in widths))
+    for row in body:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for r in rounds:
+        if r["parsed"] is None:
+            lines.append(f"note: r{r['round']:02d} has no parsed artifact "
+                         f"(driver rc!=0) — skipped")
+    if latest is not None:
+        if ref is None:
+            lines.append(
+                f"note: r{latest['round']:02d} "
+                f"(backend={latest['backend']}) has no earlier "
+                f"same-backend round to compare against")
+        else:
+            lines.append(
+                f"note: Δ compares r{latest['round']:02d} against "
+                f"r{ref['round']:02d} (backend={latest['backend']})")
+    return "\n".join(lines)
+
+
+def latest_parsed(rounds: List[dict]) -> Optional[dict]:
+    for r in reversed(rounds):
+        if r["parsed"] is not None:
+            return r
+    return None
+
+
+def reference_round(rounds: List[dict]) -> Optional[dict]:
+    """Most recent parsed round BEFORE the latest one with the same
+    backend — apples to apples across outage fallbacks."""
+    latest = latest_parsed(rounds)
+    if latest is None:
+        return None
+    for r in reversed(rounds):
+        if r["round"] >= latest["round"] or r["parsed"] is None:
+            continue
+        if r["backend"] == latest["backend"]:
+            return r
+    return None
+
+
+def find_regressions(rounds: List[dict],
+                     threshold: float = DEFAULT_THRESHOLD
+                     ) -> List[Tuple[str, float, float, float]]:
+    """[(metric, latest, reference, drop_fraction)] for every metric of
+    the latest round that fell more than ``threshold`` below the
+    same-backend reference round."""
+    latest = latest_parsed(rounds)
+    ref = reference_round(rounds)
+    if latest is None or ref is None:
+        return []
+    lm = flatten_metrics(latest["parsed"])
+    rm = flatten_metrics(ref["parsed"])
+    out = []
+    for metric, ref_v in sorted(rm.items()):
+        new_v = lm.get(metric)
+        if new_v is None:
+            continue  # metric dropped from the artifact, not a perf claim
+        drop = (ref_v - new_v) / ref_v
+        if drop > threshold:
+            out.append((metric, new_v, ref_v, drop))
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."),
+        help="directory holding the BENCH_r*.json artifacts")
+    p.add_argument("--glob", default="BENCH_r*.json")
+    p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                   help="fractional drop that counts as a regression "
+                        "(default 0.2 = 20%%)")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 when the latest round regresses any "
+                        "metric beyond the threshold")
+    p.add_argument("--json", action="store_true",
+                   help="machine form: metrics per round + regressions")
+    a = p.parse_args(argv)
+
+    rounds = load_rounds(a.dir, a.glob)
+    regressions = find_regressions(rounds, a.threshold)
+    if a.json:
+        print(json.dumps({
+            "rounds": [{"round": r["round"], "backend": r["backend"],
+                        "metrics": flatten_metrics(r["parsed"])}
+                       for r in rounds],
+            "threshold": a.threshold,
+            "regressions": [
+                {"metric": m, "latest": nv, "reference": rv,
+                 "drop": round(d, 4)}
+                for m, nv, rv, d in regressions],
+        }, indent=2, sort_keys=True))
+    else:
+        print(render_table(rounds))
+    if regressions:
+        for m, nv, rv, d in regressions:
+            print(f"benchtrend: REGRESSION {m}: {_human(nv)} is "
+                  f"{d * 100:.1f}% below {_human(rv)} "
+                  f"(threshold {a.threshold * 100:.0f}%)",
+                  file=sys.stderr)
+        if a.check:
+            return 1
+    elif a.check:
+        print("benchtrend: OK — no metric regressed beyond "
+              f"{a.threshold * 100:.0f}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,10 +10,17 @@ echo "== static analysis: python -m cylon_tpu.analysis =="
 python -m cylon_tpu.analysis
 
 echo "== telemetry smoke: scripts/smoke_telemetry.py =="
-# a two-shuffle pipeline must produce a parseable JSONL trace, a
-# Prometheus dump with nonzero shuffle_bytes_total, and an EXPLAIN
-# ANALYZE report whose shuffle count matches the phase labels
+# a two-shuffle pipeline must produce a parseable JSONL trace (with
+# per-exchange skew attributes), a Prometheus dump with nonzero
+# shuffle_bytes_total + per-shard shuffle histograms + kernel
+# compile-seconds, and an EXPLAIN ANALYZE report whose shuffle count
+# matches the phase labels and whose Shuffle nodes carry skew stats
 python scripts/smoke_telemetry.py
+
+echo "== bench trend: scripts/benchtrend.py --check =="
+# the committed BENCH_r*.json trajectory must parse, render, and show
+# no >20% regression of the latest round vs its same-backend reference
+python scripts/benchtrend.py --check
 
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu exec python -m pytest tests/ -q -m 'not slow' \

@@ -6,13 +6,19 @@ so the groupby cannot aggregate in place) on the virtual CPU mesh and
 verifies the observability layer end to end:
 
 * the JSONL span sink produced a trace where EVERY line parses, the
-  tree links up (parent_id resolves), and both ``plan.shuffle*``
-  exchange stages appear;
+  tree links up (parent_id resolves), both ``plan.shuffle*`` exchange
+  stages appear, and the ``shuffle.exchange*`` spans carry the skew
+  attributes (``skew_imbalance`` + shard-row min/med/max) computed
+  from the count matrices;
 * the Prometheus dump renders and carries a NONZERO
   ``cylon_shuffle_bytes_total`` (the exchange counters are wired, not
-  decorative);
-* ``explain(analyze=True)`` renders per-node measured rows and its
-  reported shuffle count equals ``collect_phases.count("plan.shuffle")``.
+  decorative), the per-shard shuffle histograms
+  (``cylon_shuffle_shard_rows`` / ``_shard_bytes``), host-sync
+  counters, and ``cylon_kernel_compile_seconds`` from the enabled
+  compile-cost profiler;
+* ``explain(analyze=True)`` renders per-node measured rows, its
+  reported shuffle count equals ``collect_phases.count("plan.shuffle")``,
+  and its exchange-bearing nodes render ``skew(...)`` columns.
 
 Exit 0 on success; any failure prints the offending artifact and exits
 non-zero, failing the gate.
@@ -42,7 +48,11 @@ def main() -> None:
 
     import cylon_tpu as ct
     from cylon_tpu import plan, telemetry
+    from cylon_tpu.telemetry import profiler
 
+    # compile-cost capture must be on BEFORE the first kernel factory
+    # builds (the lru memo would otherwise keep unwrapped programs)
+    profiler.enable()
     ctx = ct.CylonContext.InitDistributed(ct.TPUConfig(world_size=4))
     rng = np.random.default_rng(0)
     n = 4096
@@ -84,11 +94,26 @@ def main() -> None:
     if len(shuffle_spans) != 2:
         fail(f"expected 2 plan.shuffle* spans in the trace, got "
              f"{[r['name'] for r in shuffle_spans]}")
+    # every exchange span must carry the skew attributes (reduced from
+    # the already-fetched count matrix — the zero-extra-sync contract)
+    ex_spans = [r for r in recs
+                if r["name"].startswith("shuffle.exchange")]
+    if not ex_spans:
+        fail("no shuffle.exchange* spans in the trace")
+    for r in ex_spans:
+        missing = [k for k in ("skew_imbalance", "shard_rows_min",
+                               "shard_rows_med", "shard_rows_max")
+                   if k not in r["attrs"]]
+        if missing:
+            fail(f"exchange span {r['name']} lacks skew attrs "
+                 f"{missing}: {r['attrs']}")
 
     # -- EXPLAIN ANALYZE: measured + label-consistent -----------------
     rep = pipe.last_report
     if "rows=" not in txt or "actual time=" not in txt:
         fail(f"explain(analyze=True) missing measurements:\n{txt}")
+    if "skew(imb=" not in txt:
+        fail(f"explain(analyze=True) missing skew columns:\n{txt}")
     if rep.shuffle_count != cp.count("plan.shuffle"):
         fail(f"report shuffle_count {rep.shuffle_count} != "
              f"collect_phases {cp.count('plan.shuffle')}")
@@ -106,10 +131,22 @@ def main() -> None:
         fail(f"cylon_shuffle_bytes_total is zero: {bytes_lines[0]}")
     if "cylon_phase_latency_ms_bucket" not in prom:
         fail("phase latency histogram missing from Prometheus dump")
+    for series in ("cylon_shuffle_shard_rows_bucket",
+                   "cylon_shuffle_shard_bytes_bucket",
+                   "cylon_shuffle_imbalance_factor_bucket",
+                   "cylon_kernel_compile_seconds_bucket",
+                   "cylon_host_syncs_total"):
+        if series not in prom:
+            fail(f"{series} missing from Prometheus dump")
+    n_compiles = len(profiler.records())
+    if n_compiles == 0:
+        fail("compile-cost profiler recorded no programs")
 
     print(f"telemetry smoke: OK — {len(recs)} spans traced, "
           f"{rep.shuffle_count} exchanges measured, "
-          f"{bytes_lines[0].split()[1]} shuffle bytes counted")
+          f"{bytes_lines[0].split()[1]} shuffle bytes counted, "
+          f"{len(ex_spans)} exchange span(s) with skew attrs, "
+          f"{n_compiles} kernel compile(s) profiled")
 
 
 if __name__ == "__main__":

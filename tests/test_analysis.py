@@ -152,6 +152,36 @@ def test_collectives_real_catalog_clean():
     assert any("TPU-only" in n for n in res.notes)
 
 
+def test_collectives_uncataloged_factory_fixture():
+    """The old coverage NOTE is now a real finding: a `_*_fn` in
+    parallel/ outside the entry-point catalog fails the gate, and an
+    intentional exclusion is a per-line suppression (counted), never a
+    hidden set."""
+    res = run_checkers(
+        AnalysisContext(PKG_BAD,
+                        options={"collectives_coverage_only": True}),
+        families=["collectives"])
+    got = {(f.path, f.rule) for f in res.findings}
+    assert got == {("parallel/dist_ops.py",
+                    "collectives/uncataloged-factory")}, res.format_text()
+    assert len(res.findings) == 1
+    assert "_rogue_kernel_fn" in res.findings[0].message
+    # _host_helper_fn opted out on its def line — suppressed, visible
+    assert res.suppressed == 1
+
+
+def test_collectives_coverage_sweep_real_tree_pinned():
+    """Every `_*_fn` factory in the real parallel/ tree is either in
+    the catalog or carries an explicit disable (currently exactly one:
+    shuffle._to_varying_fn, which returns a host callable)."""
+    res = run_checkers(
+        AnalysisContext(PKG_REAL,
+                        options={"collectives_coverage_only": True}),
+        families=["collectives"])
+    assert res.findings == [], res.format_text()
+    assert res.suppressed == 1
+
+
 # ---------------------------------------------------------------------------
 # witness (checker level; verifier semantics in test_plan_verify.py)
 # ---------------------------------------------------------------------------

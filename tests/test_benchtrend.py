@@ -1,0 +1,156 @@
+"""scripts/benchtrend.py unit tests over synthetic BENCH trajectories:
+metric extraction across heterogeneous artifact shapes, same-backend
+reference selection, the regression predicate (incl. an injected >20%
+drop), table rendering, and the CLI exit codes check.sh gates on."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+SCRIPT = os.path.join(REPO, "scripts", "benchtrend.py")
+
+spec = importlib.util.spec_from_file_location("benchtrend", SCRIPT)
+benchtrend = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(benchtrend)
+
+
+def _artifact(value, backend="tpu", suite=None, shuffle_gbps=None,
+              local=None):
+    detail = {"backend": backend}
+    if suite is not None:
+        detail["suite"] = suite
+    if shuffle_gbps is not None:
+        detail["shuffle_gbps"] = shuffle_gbps
+    if local is not None:
+        detail["local_inner_join"] = {"rows_per_s_per_chip": local}
+    return {"metric": "dist_inner_join_rows_per_sec_per_chip",
+            "value": value, "unit": "rows/s/chip", "detail": detail}
+
+
+def _write_rounds(tmp_path, parsed_by_round):
+    for n, parsed in parsed_by_round.items():
+        path = tmp_path / f"BENCH_r{n:02d}.json"
+        path.write_text(json.dumps({"n": n, "rc": 0 if parsed else 1,
+                                    "parsed": parsed}))
+    return str(tmp_path)
+
+
+def test_flatten_metrics_shapes():
+    flat = benchtrend.flatten_metrics(_artifact(
+        1e6, suite={"groupby_agg": {"rows_per_s_per_chip": 5e5},
+                    "shuffle_wide": {"gbps_per_chip": 1.5},
+                    "plan_pipeline": {"speedup": 1.4},
+                    "broken": {"error": "ValueError: x"}},
+        shuffle_gbps=0.4, local=2e6))
+    assert flat["dist_inner_join.rows_per_s"] == 1e6
+    assert flat["groupby_agg.rows_per_s"] == 5e5
+    assert flat["shuffle_wide.gbps"] == 1.5
+    assert flat["plan_pipeline.speedup"] == 1.4
+    assert flat["shuffle.gbps"] == 0.4
+    assert flat["local_inner_join.rows_per_s"] == 2e6
+    assert not any(k.startswith("broken") for k in flat)
+    assert benchtrend.flatten_metrics(None) == {}
+    assert benchtrend.flatten_metrics({"value": 0}) == {}
+
+
+def test_no_regression_on_stable_trajectory(tmp_path):
+    d = _write_rounds(tmp_path, {
+        1: _artifact(1.00e6), 2: _artifact(1.05e6), 3: _artifact(0.95e6)})
+    rounds = benchtrend.load_rounds(d)
+    assert [r["round"] for r in rounds] == [1, 2, 3]
+    # r03 vs r02: -9.5%, below the 20% threshold
+    assert benchtrend.find_regressions(rounds) == []
+    table = benchtrend.render_table(rounds)
+    assert "dist_inner_join.rows_per_s" in table
+    assert "-9.5%" in table
+
+
+def test_injected_regression_detected(tmp_path):
+    d = _write_rounds(tmp_path, {
+        1: _artifact(1e6, suite={"groupby_agg":
+                                 {"rows_per_s_per_chip": 4e5}}),
+        2: _artifact(1e6, suite={"groupby_agg":
+                                 {"rows_per_s_per_chip": 3e5}})})
+    rounds = benchtrend.load_rounds(d)
+    regs = benchtrend.find_regressions(rounds, threshold=0.2)
+    assert [r[0] for r in regs] == ["groupby_agg.rows_per_s"]
+    metric, new_v, ref_v, drop = regs[0]
+    assert new_v == 3e5 and ref_v == 4e5
+    assert abs(drop - 0.25) < 1e-9
+    # a looser threshold lets the same trajectory pass
+    assert benchtrend.find_regressions(rounds, threshold=0.3) == []
+
+
+def test_backend_change_is_not_a_regression(tmp_path):
+    """An outage round (cpu-fallback) must never be judged against a
+    TPU round — that 100x 'drop' is the outage, not a code change."""
+    d = _write_rounds(tmp_path, {
+        1: _artifact(60e6, backend="tpu"),
+        2: _artifact(1e5, backend="cpu-fallback")})
+    rounds = benchtrend.load_rounds(d)
+    assert benchtrend.reference_round(rounds) is None
+    assert benchtrend.find_regressions(rounds) == []
+    assert "no earlier same-backend round" in \
+        benchtrend.render_table(rounds)
+
+
+def test_reference_skips_unparsed_and_other_backends(tmp_path):
+    d = _write_rounds(tmp_path, {
+        1: _artifact(50e6, backend="tpu"),
+        2: _artifact(2e5, backend="cpu-fallback"),
+        3: None,                                  # rc=1, parsed null
+        4: _artifact(40e6, backend="tpu")})
+    rounds = benchtrend.load_rounds(d)
+    latest = benchtrend.latest_parsed(rounds)
+    ref = benchtrend.reference_round(rounds)
+    assert latest["round"] == 4 and ref["round"] == 1
+    regs = benchtrend.find_regressions(rounds)  # 50M -> 40M = -20%, not >
+    assert regs == []
+    table = benchtrend.render_table(rounds)
+    assert "r03 has no parsed artifact" in table
+
+
+def test_new_and_removed_metrics_never_fail(tmp_path):
+    d = _write_rounds(tmp_path, {
+        1: _artifact(1e6, suite={"old_only":
+                                 {"rows_per_s_per_chip": 1e5}}),
+        2: _artifact(1e6, suite={"new_only":
+                                 {"rows_per_s_per_chip": 1e5}})})
+    rounds = benchtrend.load_rounds(d)
+    assert benchtrend.find_regressions(rounds) == []
+
+
+def test_cli_check_exit_codes(tmp_path):
+    d = _write_rounds(tmp_path, {
+        1: _artifact(1e6), 2: _artifact(0.5e6)})  # -50%: regression
+    bad = subprocess.run(
+        [sys.executable, SCRIPT, "--dir", d, "--check"],
+        capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "REGRESSION dist_inner_join.rows_per_s" in bad.stderr
+    ok = subprocess.run(
+        [sys.executable, SCRIPT, "--dir", d, "--check",
+         "--threshold", "0.6"],
+        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    js = subprocess.run(
+        [sys.executable, SCRIPT, "--dir", d, "--json"],
+        capture_output=True, text=True, timeout=60)
+    doc = json.loads(js.stdout)
+    assert doc["regressions"][0]["metric"] == "dist_inner_join.rows_per_s"
+    assert [r["round"] for r in doc["rounds"]] == [1, 2]
+
+
+def test_cli_over_committed_artifacts():
+    """The repo's own BENCH_r01–r05 trajectory renders and passes the
+    gate (r05 is a cpu-fallback round with no same-backend reference)."""
+    r = subprocess.run(
+        [sys.executable, SCRIPT, "--dir", REPO, "--check"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    for rnd in ("r01", "r02", "r03", "r04", "r05"):
+        assert rnd in r.stdout
+    assert "dist_inner_join.rows_per_s" in r.stdout

@@ -1,0 +1,391 @@
+"""Performance-observatory tests: shuffle skew metrics (span attrs,
+registry histograms, EXPLAIN ANALYZE columns), the kernel compile-cost
+profiler (incl. graceful degradation when the backend hides
+cost_analysis), the host-sync counter, and bench timer precision."""
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# skew statistics (unit level)
+# ---------------------------------------------------------------------------
+
+
+def test_skew_stats_uniform_matrix():
+    from cylon_tpu.telemetry import SkewStats
+
+    counts = np.full((4, 4), 100)
+    s = SkewStats.from_counts(counts, bytes_per_row=8)
+    assert s.imbalance == 1.0
+    assert s.rows_min == s.rows_med == s.rows_max == 400
+    assert s.recv_bytes == [3200] * 4
+    assert not s.warn
+    attrs = s.span_attrs()
+    assert attrs["skew_imbalance"] == 1.0
+    assert attrs["skew_warn"] is False
+
+
+def test_skew_stats_hot_destination():
+    from cylon_tpu.telemetry import SkewStats
+
+    # every source sends everything to shard 0
+    counts = np.zeros((4, 4), int)
+    counts[:, 0] = 100
+    s = SkewStats.from_counts(counts)
+    assert s.imbalance == 4.0          # max 400 / mean 100
+    assert s.rows_min == 0 and s.rows_max == 400
+    assert s.warn                      # default threshold 2.0
+    assert s.send_rows == [100] * 4
+
+
+def test_skew_stats_degenerate_cases():
+    from cylon_tpu.telemetry import SkewStats
+
+    # 1-wide mesh: skew undefined, never measured
+    assert SkewStats.from_counts(np.array([[7]])) is None
+    assert SkewStats.from_counts(np.zeros((0, 0))) is None
+    # empty exchange: nothing is hot
+    s = SkewStats.from_counts(np.zeros((4, 4), int))
+    assert s.imbalance == 1.0 and not s.warn
+
+
+def test_skew_warn_factor_env(monkeypatch):
+    from cylon_tpu.telemetry import SkewStats, skew
+
+    counts = np.zeros((4, 4), int)
+    counts[:, 0] = 10
+    counts[:, 1] = 5  # imbalance = 40 / 15 ≈ 2.67
+    assert SkewStats.from_counts(counts).warn
+    monkeypatch.setenv("CYLON_SKEW_WARN_FACTOR", "3.5")
+    assert skew.warn_factor() == 3.5
+    assert not SkewStats.from_counts(counts).warn
+
+
+def test_skew_record_feeds_histograms():
+    from cylon_tpu.telemetry import MetricsRegistry, skew
+
+    reg = MetricsRegistry()
+    counts = np.full((4, 4), 10)
+    stats = skew.observe_exchange(counts, bytes_per_row=16, registry=reg)
+    assert stats is not None
+    snap = reg.snapshot()
+    assert snap["cylon_shuffle_imbalance_factor"]["count"] == 1
+    assert snap["cylon_shuffle_shard_rows"]["count"] == 4
+    assert snap["cylon_shuffle_shard_rows"]["max"] == 40
+    assert snap["cylon_shuffle_shard_bytes"]["max"] == 640
+
+
+# ---------------------------------------------------------------------------
+# skew end to end: Zipfian shuffle on the 8-wide virtual mesh
+# ---------------------------------------------------------------------------
+
+
+def _zipf_tables(ctx, n=4096, hot=0.9, seed=0):
+    """LEFT keys are Zipf-like (one hot key → one hot destination
+    shard); RIGHT keys stay uniform so the join output is linear, not
+    quadratic — the skew under test lives in the EXCHANGE, and a
+    hot-on-both-sides join would make the test pay a many-million-row
+    materialize for nothing."""
+    import cylon_tpu as ct
+
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, n // 4, n).astype(np.int32)
+    k[rng.random(n) < hot] = 7  # one hot key → one hot destination shard
+    left = ct.Table.from_pydict(ctx, {
+        "k": k, "v": rng.normal(size=n).astype(np.float32)})
+    right = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n // 4, n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32)})
+    return left, right
+
+
+def test_zipf_shuffle_records_imbalance(dist_ctx8):
+    from cylon_tpu import telemetry
+    from cylon_tpu.parallel import dist_ops
+
+    left, _right = _zipf_tables(dist_ctx8)
+    h = telemetry.REGISTRY.histogram("cylon_shuffle_imbalance_factor",
+                                     buckets=telemetry.skew.IMBALANCE_BUCKETS)
+    n0 = h.count
+    with telemetry.collect_phases() as cp:
+        dist_ops.shuffle(left, ["k"])
+    # the collector carries the Span OBJECTS index-aligned with labels
+    assert len(cp.spans) == len(cp.labels)
+    ex = [s for s in cp.spans if s.name.startswith("shuffle.exchange")]
+    assert ex, cp.labels
+    attrs = ex[0].attrs
+    # ~90% of rows hash to one shard of 8: imbalance far above warn
+    assert attrs["skew_imbalance"] > 2.0
+    assert attrs["skew_warn"] is True
+    assert attrs["shard_rows_max"] > 8 * attrs["shard_rows_med"] / 2
+    assert h.count > n0
+    snap = telemetry.metrics_snapshot()
+    assert snap["cylon_shuffle_shard_rows"]["count"] >= 8
+
+
+def test_zipf_explain_analyze_skew_columns(dist_ctx8):
+    from cylon_tpu import plan
+
+    left, right = _zipf_tables(dist_ctx8)
+    pipe = plan.scan(left).join(plan.scan(right), on="k") \
+        .groupby("lt-0", ["rt-3"], ["sum"])
+    txt = pipe.explain(analyze=True)
+    assert "skew(imb=" in txt
+    assert "[SKEW]" in txt, txt
+    rep = pipe.last_report
+    skewed = [m for m in _walk_measures(rep.root) if m.skew is not None]
+    assert skewed
+    worst = max(m.skew["imbalance"] for m in skewed)
+    assert worst > 2.0
+    d = rep.to_dict()
+    node_skews = _walk_dict_skews(d["plan"])
+    assert any(s and s["warn"] for s in node_skews)
+
+
+def test_uniform_explain_analyze_no_warn(dist_ctx8):
+    """A uniform-hash pipeline shows skew columns near 1.0 and never
+    the [SKEW] marker."""
+    import cylon_tpu as ct
+    from cylon_tpu import plan
+
+    rng = np.random.default_rng(3)
+    n = 4096
+    left = ct.Table.from_pydict(dist_ctx8, {
+        "k": rng.integers(0, n, n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32)})
+    right = ct.Table.from_pydict(dist_ctx8, {
+        "k": rng.integers(0, n, n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32)})
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    txt = pipe.explain(analyze=True)
+    assert "skew(imb=" in txt
+    assert "[SKEW]" not in txt, txt
+
+
+def _walk_measures(m):
+    yield m
+    for c in m.children:
+        yield from _walk_measures(c)
+
+
+def _walk_dict_skews(d):
+    yield d.get("skew")
+    for c in d.get("children", []):
+        yield from _walk_dict_skews(c)
+
+
+# ---------------------------------------------------------------------------
+# host-sync counter
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_counter_at_shuffle_count(dist_ctx):
+    import cylon_tpu as ct
+    from cylon_tpu import telemetry
+    from cylon_tpu.parallel import dist_ops
+
+    def site(name):
+        return telemetry.metrics_snapshot().get(
+            f'cylon_host_syncs_total{{site="{name}"}}', 0)
+
+    s0 = site("shuffle.count")
+    t = ct.Table.from_pydict(dist_ctx, {
+        "k": np.arange(512, dtype=np.int32) % 32,
+        "v": np.arange(512.0).astype(np.float32)})
+    dist_ops.shuffle(t, ["k"])
+    assert site("shuffle.count") == s0 + 1
+
+
+def test_host_sync_counter_pair_and_plan(dist_ctx):
+    import cylon_tpu as ct
+    from cylon_tpu import telemetry
+
+    def site(name):
+        return telemetry.metrics_snapshot().get(
+            f'cylon_host_syncs_total{{site="{name}"}}', 0)
+
+    rng = np.random.default_rng(1)
+    n = 512
+    t1 = ct.Table.from_pydict(dist_ctx, {
+        "k": rng.integers(0, 64, n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32)})
+    t2 = ct.Table.from_pydict(dist_ctx, {
+        "k": rng.integers(0, 64, n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32)})
+    p0 = site("shuffle.count_pair")
+    j0 = site("join.plan")
+    t1.distributed_join(t2, "inner", on="k")
+    assert site("shuffle.count_pair") == p0 + 1
+    assert site("join.plan") == j0 + 1
+
+
+# ---------------------------------------------------------------------------
+# compile-cost profiler
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_measures_counted_cache_builds(local_ctx):
+    import jax
+    import jax.numpy as jnp
+
+    from cylon_tpu import telemetry
+    from cylon_tpu.telemetry import counted_cache, profiler
+
+    profiler.enable()
+    try:
+        @counted_cache
+        def _observatory_probe_fn(scale):
+            return jax.jit(lambda x: x * scale)
+
+        f = _observatory_probe_fn(3)
+        x = jnp.arange(8.0)
+        np.testing.assert_allclose(np.asarray(f(x)), np.arange(8.0) * 3)
+        f(x)  # repeat signature: cached executable, no re-measure
+        recs = [r for r in profiler.records()
+                if r["factory"] == "_observatory_probe_fn"]
+        assert len(recs) == 1
+        assert recs[0]["compile_s"] > 0
+        snap = telemetry.metrics_snapshot()
+        key = 'cylon_kernel_compile_seconds{factory="_observatory_probe_fn"}'
+        assert snap[key]["count"] == 1
+        # a NEW signature compiles (and measures) a second program
+        np.testing.assert_allclose(np.asarray(f(jnp.arange(16.0))),
+                                   np.arange(16.0) * 3)
+        assert telemetry.metrics_snapshot()[key]["count"] == 2
+        s = profiler.summary()["_observatory_probe_fn"]
+        assert s["programs"] == 2 and s["compile_s"] > 0
+    finally:
+        profiler.disable()
+
+
+def test_profiler_graceful_when_cost_analysis_unavailable():
+    """The CPU-degradation contract: a backend whose Compiled raises
+    from (or garbles) cost_analysis still yields compile seconds, with
+    flops/bytes None — never an error."""
+    from cylon_tpu.telemetry import profiler
+
+    class _Raises:
+        def cost_analysis(self):
+            raise NotImplementedError("no cost analysis on this backend")
+
+    class _NotADict:
+        def cost_analysis(self):
+            return "unparseable"
+
+    class _ListForm:
+        def cost_analysis(self):
+            return [{"flops": 5.0, "bytes accessed": 12.0}]
+
+    class _Partial:
+        def cost_analysis(self):
+            return {"flops": 3.0}
+
+    assert profiler._cost_analysis(_Raises()) == (None, None)
+    assert profiler._cost_analysis(_NotADict()) == (None, None)
+    assert profiler._cost_analysis(_ListForm()) == (5.0, 12.0)
+    assert profiler._cost_analysis(_Partial()) == (3.0, None)
+
+
+def test_profiler_full_path_without_cost_analysis():
+    from cylon_tpu.telemetry import profiler
+
+    class FakeCompiled:
+        def cost_analysis(self):
+            raise NotImplementedError
+
+        def __call__(self, x):
+            return x + 1
+
+    class FakeLowered:
+        def compile(self):
+            return FakeCompiled()
+
+    class FakeJit:
+        def __call__(self, x):  # pragma: no cover - fallback only
+            return x + 1
+
+        def lower(self, x):
+            return FakeLowered()
+
+    profiler.enable()
+    try:
+        p = profiler._ProfiledProgram("_fake_nocost_fn", FakeJit())
+        assert p(np.int32(1)) == 2
+        rec = [r for r in profiler.records()
+               if r["factory"] == "_fake_nocost_fn"][0]
+        assert rec["compile_s"] >= 0
+        assert rec["flops"] is None and rec["bytes_accessed"] is None
+    finally:
+        profiler.disable()
+
+
+def test_profiler_falls_back_on_non_lowerable():
+    """Factories returning plain host callables (no .lower) pass
+    through untouched — profiling is additive, never a crash."""
+    from cylon_tpu.telemetry import profiler
+
+    profiler.enable()
+    try:
+        p = profiler._ProfiledProgram("_plain_fn", lambda x: x * 2)
+        assert p(np.float32(3.0)) == 6.0
+        # kwargs route straight to the wrapped callable too
+        pk = profiler._ProfiledProgram("_kw_fn", lambda **kw: kw["k"])
+        assert pk(k=41) == 41
+        assert not [r for r in profiler.records()
+                    if r["factory"] in ("_plain_fn", "_kw_fn")]
+    finally:
+        profiler.disable()
+
+
+def test_profiler_disabled_is_passthrough():
+    from cylon_tpu.telemetry import metrics as _metrics
+    from cylon_tpu.telemetry import profiler
+
+    profiler.disable()
+    assert _metrics._factory_build_hook is None
+    # hook uninstalled: counted_cache returns the bare build result
+    from cylon_tpu.telemetry import counted_cache
+
+    @counted_cache
+    def _bare_probe_fn():
+        return lambda: 41
+
+    assert _bare_probe_fn()() == 41
+    assert not isinstance(_bare_probe_fn(), profiler._ProfiledProgram)
+
+
+# ---------------------------------------------------------------------------
+# bench timer precision (satellite: BENCH_r05 wall_s_best 0.0)
+# ---------------------------------------------------------------------------
+
+
+def test_round_sig_keeps_submillisecond_walls():
+    from cylon_tpu.benchutils import round_sig
+
+    assert round_sig(0.0000234567891) == 0.0000234568
+    assert round_sig(0.023456789) == 0.0234568
+    assert round_sig(1234567.891) == 1234570.0
+    assert round_sig(0.0) == 0.0
+    assert round_sig(float("inf")) == float("inf")
+    assert round_sig(7) == 7  # non-floats pass through
+
+
+def test_bench_sig_matches_benchutils():
+    import bench
+    from cylon_tpu.benchutils import round_sig
+
+    for v in (0.00012345678, 0.9876543, 123456.789):
+        assert bench._sig(v) == round_sig(v)
+
+
+def test_bench_walls_nonzero_and_consistent(local_ctx):
+    """A sub-millisecond config must report a nonzero wall that is
+    self-consistent with its rate (rate * wall ≈ rows)."""
+    import bench
+
+    ctx = bench._mk_ctx()
+    res = bench.bench_local_join(ctx, 1 << 8, iters=1)
+    wall = res["wall_s_best"]
+    assert wall > 0.0
+    rows = res["rows_per_s_per_chip"] * wall
+    assert rows == pytest.approx(2 * (1 << 8), rel=1e-3)
