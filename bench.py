@@ -604,6 +604,11 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
     from cylon_tpu import telemetry as _telemetry
 
     _telemetry.sample_memory(ctx.memory_pool)
+    # memory trajectory for future benchtrend rounds: the run's HBM
+    # high-water mark (ledger-backed on stats-hidden backends) and the
+    # ledger's end-of-run leak count — a growing leak count across
+    # rounds is a regression even when throughput holds
+    _hbm_used, _hbm_peak, _hbm_limit = ctx.memory_pool.snapshot()
     return {
         "metric": "dist_inner_join_rows_per_sec_per_chip",
         "value": round(rps, 1),
@@ -613,6 +618,8 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
         "detail": {
             "n_rows_per_side": n_rows,
             "world": ctx.get_world_size(),
+            "peak_hbm_bytes": int(_hbm_peak),
+            "ledger_leaks": int(_telemetry.ledger.leak_count()),
             "wall_s_best": dist_res["wall_s_best"],
             "out_rows": dist_res["out_rows"],
             "backend": jax.devices()[0].platform,

@@ -20,7 +20,12 @@ registered in `core.CHECKERS` and runnable from one entry point:
                       executor lowering must run under a telemetry
                       span (the observability layer's coverage
                       contract — an unspanned operator is invisible
-                      to shuffle counting and EXPLAIN ANALYZE).
+                      to shuffle counting and EXPLAIN ANALYZE);
+* ``ledger-coverage`` — the memory analog: every materializing
+                      ``distributed_*`` op and executor lowering must
+                      register its output with the telemetry ledger,
+                      or its HBM is unattributable to gauges, leak
+                      reports and crash dumps.
 
 Run ``python -m cylon_tpu.analysis`` (see ``--help``); wired into
 ``scripts/check.sh`` ahead of tier-1. Rule catalog, suppression syntax
@@ -37,6 +42,7 @@ from . import hostsync as _hostsync          # noqa: F401,E402
 from . import collectives as _collectives    # noqa: F401,E402
 from . import witness as _witness            # noqa: F401,E402
 from . import spancov as _spancov            # noqa: F401,E402
+from . import ledgercov as _ledgercov        # noqa: F401,E402
 
 __all__ = ["AnalysisContext", "CHECKERS", "Finding", "RunResult",
            "SCHEMA_VERSION", "register", "run_checkers", "to_json_text"]

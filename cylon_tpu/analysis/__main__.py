@@ -81,7 +81,8 @@ def main(argv=None) -> int:
         # scanning a fixture/foreign tree: the semantic checkers
         # (collectives/witness) are about the REAL package's kernels
         # and optimizer — run only the file-scanning families
-        families = ["layering", "hostsync", "span-coverage"]
+        families = ["layering", "hostsync", "span-coverage",
+                    "ledger-coverage"]
 
     ctx = AnalysisContext(root, options)
     try:

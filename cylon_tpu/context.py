@@ -94,10 +94,18 @@ class CylonContext:
         self.mesh = jax.sharding.Mesh(np.array(devices), (_AXIS,))
 
         from .memory import MemoryPool
+        from . import telemetry as _telemetry
 
         self.memory_pool = MemoryPool(
             [d for d in devices
              if d.process_index == jax.process_index()])
+        # observability wiring: on backends that hide memory_stats the
+        # pool falls back to the ledger's tracked-table bytes (self-
+        # accounting instead of blindness), and the span layer samples
+        # this pool for per-span hbm_delta/hbm_peak attrs + the flight
+        # recorder's crash-dump watermarks
+        self.memory_pool.set_external_source(_telemetry.ledger.live_bytes)
+        _telemetry.set_memory_pool(self.memory_pool)
 
     # -- reference API (cylon_context.hpp) --
 

@@ -117,25 +117,30 @@ class Table:
         """Physical (padded) row slots."""
         return len(self._columns[0]) if self._columns else 0
 
-    @property
-    def nbytes(self) -> int:
-        """Device bytes this table's buffers span (data + validity +
-        varbytes words/starts + row mask) — shape × itemsize, computed
-        on the host with NO device sync. The telemetry layer's
-        ``bytes`` measurement for EXPLAIN ANALYZE reports."""
-        def _nb(arr) -> int:
-            return int(np.dtype(arr.dtype).itemsize) * \
-                int(np.prod(arr.shape))
-
-        total = 0 if self.row_mask is None else _nb(self.row_mask)
+    def buffers(self) -> List:
+        """Every device buffer this table references (data + validity +
+        varbytes words/starts + row mask) — the canonical enumeration
+        behind ``nbytes``, and the telemetry ledger's identity set for
+        deduplicating shared-buffer views (zero-copy project/filter
+        outputs must not double-count live bytes)."""
+        out = [] if self.row_mask is None else [self.row_mask]
         for c in self._columns:
-            total += _nb(c.data)
+            out.append(c.data)
             if c.validity is not None:
-                total += _nb(c.validity)
+                out.append(c.validity)
             if c.is_varbytes:
                 vb = c.varbytes
-                total += _nb(vb.words) + _nb(vb.starts)
-        return total
+                out.append(vb.words)
+                out.append(vb.starts)
+        return out
+
+    @property
+    def nbytes(self) -> int:
+        """Device bytes this table's buffers span — shape × itemsize,
+        computed on the host with NO device sync. The telemetry layer's
+        ``bytes`` measurement for EXPLAIN ANALYZE reports."""
+        return sum(int(np.dtype(a.dtype).itemsize) * int(np.prod(a.shape))
+                   for a in self.buffers())
 
     def emit_mask(self) -> jnp.ndarray:
         if self.row_mask is None:
@@ -283,6 +288,10 @@ class Table:
     print = show  # reference: Table::Print
 
     def clear(self) -> None:
+        # free event: retire this table's ledger entry (if any) so
+        # cylon_live_table_bytes drops and leak reports stay honest —
+        # _free_if_unretained and finalize both route through here
+        _telemetry.ledger.release(self)
         self._columns = []
         self.row_mask = None
         self._row_count_cache = None

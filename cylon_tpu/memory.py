@@ -12,7 +12,7 @@ buffers from the pool, arrow_all_to_all.cpp:234-247).
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 # HBM per chip when the runtime hides memory_stats (tunneled backends —
 # the axon platform returns None): v5e carries 16 GiB. Overridable via
@@ -33,25 +33,66 @@ class MemoryPool:
                          if _stats(d) is not None]
         self.comm_fraction = comm_fraction
         self._fallback_limit = None
+        # monotonic high-water mark over snapshot() observations — the
+        # only peak signal on backends that hide memory_stats (axon
+        # tunnels, the CPU test platform): without it, span hbm_peak
+        # attrs and crash-dump watermarks silently read 0 there
+        self._peak_seen = 0
+        # external live-bytes source (duck-typed zero-arg callable —
+        # the telemetry ledger's tracked-table total; memory.py stays a
+        # base-layer leaf and never imports telemetry). Consulted only
+        # when no local device exposes memory_stats.
+        self._external_live: Optional[Callable[[], int]] = None
         if not self._devices and any(
                 getattr(d, "platform", "") in ("tpu", "axon")
                 for d in devices):
             self._fallback_limit = int(os.environ.get(
                 "CYLON_HBM_BYTES", DEFAULT_TPU_HBM_BYTES))
 
+    def set_external_source(self, fn: Optional[Callable[[], int]]) -> None:
+        """Register a fallback live-bytes provider (the telemetry
+        ledger's ``live_bytes``) used when the runtime hides per-device
+        memory stats — self-accounting instead of blindness."""
+        self._external_live = fn
+
+    def snapshot(self) -> Tuple[int, int, int]:
+        """``(bytes_in_use, peak_bytes, bytes_limit)`` summed over local
+        devices, with ONE ``memory_stats`` call per device (the
+        bytes_allocated/peak_bytes/bytes_limit trio used to pay three).
+        When every device hides its stats, ``bytes_in_use`` falls back
+        to the external (ledger) source and ``peak_bytes`` to the
+        pool's own monotonic high-water mark over those observations —
+        the fix for hbm_peak reading 0 on tunneled backends."""
+        used = peak = limit = 0
+        seen = False
+        for d in self._devices:
+            s = _stats(d)
+            if s is None:
+                continue
+            seen = True
+            used += s.get("bytes_in_use", 0) or 0
+            peak += s.get("peak_bytes_in_use", 0) or 0
+            limit += s.get("bytes_limit", 0) or 0
+        if not seen:
+            if self._external_live is not None:
+                try:
+                    used = int(self._external_live())
+                except Exception:  # pragma: no cover - defensive
+                    used = 0
+            limit = self._fallback_limit or 0
+        self._peak_seen = max(self._peak_seen, used)
+        return used, max(peak, self._peak_seen), limit
+
     def bytes_allocated(self) -> int:
-        """Live HBM across local mesh devices (0 when the backend does not
-        expose memory_stats, e.g. the CPU test platform)."""
-        return sum(s.get("bytes_in_use", 0)
-                   for d in self._devices if (s := _stats(d)) is not None)
+        """Live HBM across local mesh devices; ledger-tracked bytes when
+        the backend hides memory_stats (0 with no external source)."""
+        return self.snapshot()[0]
 
     def peak_bytes(self) -> int:
-        return sum(s.get("peak_bytes_in_use", 0)
-                   for d in self._devices if (s := _stats(d)) is not None)
+        return self.snapshot()[1]
 
     def bytes_limit(self) -> int:
-        return sum(s.get("bytes_limit", 0)
-                   for d in self._devices if (s := _stats(d)) is not None)
+        return self.snapshot()[2]
 
     def available_bytes(self) -> Optional[int]:
         """Free HBM on the tightest local device; the static chip limit

@@ -47,7 +47,8 @@ from ..ops import order as _order
 from ..ops import setops as _setops
 from ..status import Code, CylonError
 from ..telemetry import annotate as _annotate, counted_cache, \
-    phase as _phase, record_host_sync as _host_sync, span as _span
+    ledger as _ledger, phase as _phase, record_host_sync as _host_sync, \
+    span as _span
 from . import shard
 from ..util import capacity as _capacity
 from .shuffle import count_pair, exchange, exchange_pair, \
@@ -769,7 +770,7 @@ def shuffle(table: Table, hash_columns: Sequence) -> Table:
     result._hash_partitioned = sig
     # reference parity: Shuffle frees non-retained inputs (table.cpp:207)
     table._free_if_unretained()
-    return result
+    return _ledger.track(result, "shuffle")
 
 
 def hash_partition(table: Table, hash_columns: Sequence,
@@ -877,7 +878,7 @@ def repartition(table: Table, ctx: CylonContext) -> Table:
     cols, new_emit, _x = _exchange_table(
         t, targets, shard.pin(t.emit_mask(), ctx), ctx,
         dense=t.row_mask is None)
-    return Table(cols, ctx, new_emit)
+    return _ledger.track(Table(cols, ctx, new_emit), "repartition")
 
 
 # ---------------------------------------------------------------------------
@@ -894,7 +895,8 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
     if world == 1 and not (force_exchange and ctx.is_distributed()):
         # reference parity: world==1 short-circuits to the local join
         # (table.cpp:662-669)
-        return table_mod.join(left, right, config)
+        return _ledger.track(table_mod.join(left, right, config),
+                             "distributed_join")
     exact_pairs = []
     if getattr(config, "exact", False):
         from ..data.strings import EXACT_KEY_WORDS
@@ -1081,7 +1083,7 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
             rcols2, tuple(nl + j for j in ridx), world)
     left._free_if_unretained()
     right._free_if_unretained()
-    return result
+    return _ledger.track(result, "distributed_join")
 
 
 def _exact_post_verify(res: Table, nl: int, pairs, config):
@@ -1435,7 +1437,7 @@ def distributed_join_ring(left: Table, right: Table,
     result = Table(cols, ctx, emit)
     left._free_if_unretained()
     right._free_if_unretained()
-    return result
+    return _ledger.track(result, "distributed_join_ring")
 
 
 # ---------------------------------------------------------------------------
@@ -1450,7 +1452,8 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp,
     ctx = left._ctx
     world = ctx.get_world_size()
     if world == 1 and not (force_exchange and ctx.is_distributed()):
-        return table_mod.set_op(left, right, op)
+        return _ledger.track(table_mod.set_op(left, right, op),
+                             "distributed_set_op")
     if left.column_count != right.column_count:
         raise CylonError(Code.Invalid, "set ops need equal schemas")
 
@@ -1554,7 +1557,7 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp,
                                varbytes=vb))
         else:
             cols.append(Column(d, a.dtype, v, a.dictionary, a.name))
-    return Table(cols, ctx, emit)
+    return _ledger.track(Table(cols, ctx, emit), "distributed_set_op")
 
 
 # ---------------------------------------------------------------------------
@@ -1641,8 +1644,10 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
     ctx = table._ctx
     world = ctx.get_world_size()
     if world == 1:
-        return table_mod.groupby_local(table, index_col, aggregate_cols,
-                                       aggregate_ops)
+        return _ledger.track(
+            table_mod.groupby_local(table, index_col, aggregate_cols,
+                                    aggregate_ops),
+            "distributed_groupby")
 
     t = shard.distribute(table, ctx)
     idx_cols = index_col if isinstance(index_col, (list, tuple)) else [index_col]
@@ -1682,7 +1687,7 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
         # hash): witness lets a further same-key stage skip its shuffle
         out._hash_partitioned = shard.partition_signature(
             key_out, tuple(range(len(key_out))), world)
-        return out
+        return _ledger.track(out, "distributed_groupby")
 
     # ---- phase A: per-shard partial aggregation (shuffle bytes then
     # scale with per-shard GROUPS, not rows). MEAN expands to
@@ -1770,7 +1775,7 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
     # partitioning so later same-key stages can elide their shuffles
     out._hash_partitioned = shard.partition_signature(
         key_out, tuple(range(len(key_out))), world)
-    return out
+    return _ledger.track(out, "distributed_groupby")
 
 
 # ---------------------------------------------------------------------------
@@ -1969,6 +1974,6 @@ def distributed_sort(table: Table, order_by, ascending=True,
                                    varbytes=vb))
         else:
             out_cols.append(Column(d, c.dtype, v, c.dictionary, c.name))
-    return Table(out_cols, ctx, semit)
+    return _ledger.track(Table(out_cols, ctx, semit), "distributed_sort")
 
 

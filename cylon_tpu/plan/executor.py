@@ -28,6 +28,16 @@ root span and records per-node inclusive wall time, output rows/bytes
 and own telemetry labels into a `report.PlanReport`. The default
 `execute` path carries ZERO of this overhead (no recorder, no row-count
 syncs) — analysis is opt-in per query.
+
+Memory observability: every lowering registers its output with the
+telemetry LEDGER (``ledger-coverage`` checker — the memory analog of
+span-coverage), so `cylon_live_table_bytes{owner=plan.*}` attributes
+HBM to query nodes and `execute_analyzed` can render an end-of-query
+leak report (tables allocated under the query's root span and never
+freed). Before running, both paths compute the planner's PRE-FLIGHT
+output-size estimates (report.preflight_estimates); a plan whose
+estimate exceeds the pool's comm budget emits a ``plan.preflight``
+warning span — visible in the trace BEFORE the query OOMs.
 """
 from __future__ import annotations
 
@@ -38,7 +48,7 @@ from .. import table_api, telemetry
 from ..data import table as table_mod
 from ..data.table import Table
 from ..status import Code, CylonError
-from ..telemetry import span as _span
+from ..telemetry import ledger as _ledger, span as _span
 from . import ir
 
 
@@ -46,10 +56,54 @@ def _world(ctx) -> int:
     return ctx.get_world_size() if ctx.is_distributed() else 1
 
 
+def _resolve_ctx(plan: ir.PlanNode, ctx):
+    """The context a plan will run under, resolvable BEFORE execution
+    (the executor itself binds lazily from the first Scan)."""
+    if ctx is not None:
+        return ctx
+    for node in ir.walk(plan):
+        if node.kind == "scan" and node.table is not None:
+            return node.table._ctx
+    return None
+
+
+def _preflight(plan: ir.PlanNode, ctx):
+    """Pre-execution memory check: estimate every node's output bytes
+    from schema widths × propagated row estimates and compare against
+    the pool's comm budget. Over-budget plans emit ONE ``plan.preflight``
+    warning span (attrs: worst node, estimate, budget) and a WARNING
+    log line — the observable moment before a potential OOM. Returns
+    (estimates map, budget)."""
+    from .report import preflight_estimates
+
+    est = preflight_estimates(plan)
+    pool = getattr(ctx, "memory_pool", None) if ctx is not None else None
+    budget = pool.comm_budget_bytes() if pool is not None else None
+    if not budget:
+        return est, budget
+    over = [n for n in ir.walk(plan)
+            if (b := est[id(n)]["bytes"]) is not None and b > budget]
+    if over:
+        worst = max(over, key=lambda n: est[id(n)]["bytes"])
+        with _span("plan.preflight", over_budget_nodes=len(over),
+                   worst_node=f"{type(worst).__name__}"
+                              f"({worst.args_repr()})",
+                   est_bytes=int(est[id(worst)]["bytes"]),
+                   comm_budget_bytes=int(budget)):
+            telemetry.logger.warning(
+                "plan.preflight: %d node(s) estimate beyond the comm "
+                "budget (%d B); worst %s at %d B — expect blocked/"
+                "chunked execution or an OOM",
+                len(over), budget, type(worst).__name__,
+                est[id(worst)]["bytes"])
+    return est, budget
+
+
 def execute(plan: ir.PlanNode, ctx=None) -> Table:
     """Execute a plan; returns the result Table (sharded when the
     context is distributed). ``ctx`` defaults to the first scanned
     table's context."""
+    _preflight(plan, _resolve_ctx(plan, ctx))
     return _Exec(ctx).run(plan)
 
 
@@ -59,26 +113,32 @@ def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None
 
     The whole run nests under one ``plan.query`` span (the report's
     span tree); HBM gauges are sampled from the context's MemoryPool
-    after the run, and the registry snapshot rides along so a BENCH
-    artifact is one ``report.to_dict()`` away."""
+    after the run, the registry snapshot rides along so a BENCH
+    artifact is one ``report.to_dict()`` away, and the ledger's
+    end-of-query leak report (allocated under this root span, never
+    freed, query result excluded) lands on ``report.leaks``."""
     from .report import PlanReport, build_measures
 
     with telemetry.collect_phases() as cp:
         with _span("plan.query") as root_span:
+            est, budget = _preflight(plan, _resolve_ctx(plan, ctx))
             ex = _Exec(ctx, recorder=_Recorder(cp.labels))
             result = ex.run(plan)
+    leaks = _ledger.leak_report(root_span.span_id,
+                                exclude={id(result)})
     pool = getattr(ex.ctx, "memory_pool", None) if ex.ctx is not None \
         else None
     memory = telemetry.sample_memory(pool) if pool is not None else {}
     report = PlanReport(
         root=build_measures(plan, ex._recorder.recs, cp.labels,
-                            spans=cp.spans),
+                            spans=cp.spans, est=est, budget=budget),
         span=root_span,
         shuffle_count=cp.count("plan.shuffle"),
         total_ms=root_span.elapsed_ms,
         world=_world(ex.ctx) if ex.ctx is not None else 1,
         stats=stats, memory=memory,
-        metrics=telemetry.metrics_snapshot())
+        metrics=telemetry.metrics_snapshot(),
+        leaks=leaks, budget=budget)
     return result, report
 
 
@@ -134,7 +194,9 @@ class _Exec:
             if self.ctx is None:
                 self.ctx = t._ctx
             sp.set(rows_in=t.capacity, world=_world(self.ctx))
-        return t
+        # borrowed: the engine did not allocate a scan input — it
+        # counts toward live bytes but never toward a leak report
+        return _ledger.track(t, "plan.scan", borrowed=True)
 
     # -- row/column ops -------------------------------------------------
 
@@ -142,12 +204,13 @@ class _Exec:
         t = self.run(node.children[0])
         with _span("plan.project", self._seq(), cols=len(node.cols),
                    rows_in=t.capacity):
-            return t.project(node.cols)
+            return _ledger.track(t.project(node.cols), "plan.project")
 
     def _do_filter(self, node: ir.Filter) -> Table:
         t = self.run(node.children[0])
         with _span("plan.filter", self._seq(), rows_in=t.capacity):
-            return t.filter_mask(node.expr.mask(t))
+            return _ledger.track(t.filter_mask(node.expr.mask(t)),
+                                 "plan.filter")
 
     # -- exchanges ------------------------------------------------------
 
@@ -192,7 +255,8 @@ class _Exec:
             return t
         with _span("plan.shuffle.explicit", self._seq(),
                    world=_world(self.ctx), rows_in=t.capacity):
-            return dist_ops.shuffle(t, node.keys)
+            return _ledger.track(dist_ops.shuffle(t, node.keys),
+                                 "plan.shuffle")
 
     def _do_join(self, node: ir.Join) -> Table:
         l, r = node.children
@@ -216,9 +280,12 @@ class _Exec:
         with _span(label, self._seq(), world=world, how=node.how,
                    sides_exchanged=n_ex,
                    rows_in=lt.capacity + rt.capacity):
-            return lt.distributed_join(
-                rt, node.how, node.algorithm,
-                left_on=list(node.left_on), right_on=list(node.right_on))
+            return _ledger.track(
+                lt.distributed_join(
+                    rt, node.how, node.algorithm,
+                    left_on=list(node.left_on),
+                    right_on=list(node.right_on)),
+                "plan.join")
 
     def _do_groupby(self, node: ir.GroupBy) -> Table:
         from ..parallel import dist_ops, shard
@@ -228,8 +295,10 @@ class _Exec:
         if _world(self.ctx) == 1:
             with _span("plan.groupby", self._seq(), world=1,
                        rows_in=t.capacity):
-                return table_mod.groupby_local(t, node.keys,
-                                               node.agg_cols, ops)
+                return _ledger.track(
+                    table_mod.groupby_local(t, node.keys,
+                                            node.agg_cols, ops),
+                    "plan.groupby")
         local = False
         if node.local_ok:
             # re-verify the plan's claim against the runtime witness —
@@ -241,8 +310,11 @@ class _Exec:
         label = "plan.groupby" if local else "plan.shuffle.groupby"
         with _span(label, self._seq(), world=_world(self.ctx),
                    local=local, rows_in=t.capacity):
-            return dist_ops.distributed_groupby(
-                t, node.keys, node.agg_cols, ops, pre_partitioned=local)
+            return _ledger.track(
+                dist_ops.distributed_groupby(
+                    t, node.keys, node.agg_cols, ops,
+                    pre_partitioned=local),
+                "plan.groupby")
 
     def _do_setop(self, node: ir.SetOp) -> Table:
         lt = self.run(node.children[0])
@@ -250,11 +322,13 @@ class _Exec:
         if _world(self.ctx) == 1:
             with _span("plan.setop", self._seq(), world=1, op=node.op,
                        rows_in=lt.capacity + rt.capacity):
-                return getattr(lt, node.op)(rt)
+                return _ledger.track(getattr(lt, node.op)(rt),
+                                     "plan.setop")
         with _span("plan.shuffle.setop", self._seq(),
                    world=_world(self.ctx), op=node.op,
                    rows_in=lt.capacity + rt.capacity):
-            return getattr(lt, f"distributed_{node.op}")(rt)
+            return _ledger.track(
+                getattr(lt, f"distributed_{node.op}")(rt), "plan.setop")
 
     def _do_sort(self, node: ir.Sort) -> Table:
         from ..parallel import dist_ops
@@ -263,7 +337,10 @@ class _Exec:
         if _world(self.ctx) == 1:
             with _span("plan.sort", self._seq(), world=1,
                        rows_in=t.capacity):
-                return t.sort(node.by, node.ascending)
+                return _ledger.track(t.sort(node.by, node.ascending),
+                                     "plan.sort")
         with _span("plan.shuffle.sort", self._seq(),
                    world=_world(self.ctx), rows_in=t.capacity):
-            return dist_ops.distributed_sort(t, node.by, node.ascending)
+            return _ledger.track(
+                dist_ops.distributed_sort(t, node.by, node.ascending),
+                "plan.sort")

@@ -28,6 +28,17 @@ summary rendered as ``skew(imb=… rows/shard min/med/max=…)``, with a
 ``[SKEW]`` marker once the imbalance crosses the configurable warning
 threshold (``CYLON_SKEW_WARN_FACTOR``, default 2.0).
 
+Memory columns: every executed node renders ``est=…`` beside the
+measured ``bytes=…`` — the planner's PRE-FLIGHT output-size estimate
+(``preflight_estimates``: schema widths × propagated row estimates,
+pure host arithmetic, no execution). A ``[MEM]`` marker appears when a
+node's estimate exceeds the pool's ``comm_budget_bytes()`` — the same
+budget the shuffle sizes its rounds against — so a beyond-budget plan
+is visible in the report (and via the executor's pre-execution
+``plan.preflight`` warning span) BEFORE it OOMs. The trailing leak
+lines come from the telemetry ledger: tables allocated under the
+query's root span and never freed.
+
 Time semantics: ``ms`` is INCLUSIVE of children (Postgres "actual
 time"); host-visible wall clock, so async dispatch cost unless the
 node ends in a host sync (see telemetry docstring). Rows are LIVE rows
@@ -38,6 +49,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from . import ir
 
 
@@ -47,6 +60,81 @@ def _human_bytes(n: int) -> str:
             return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
         n /= 1024.0
     return f"{n:.1f} GiB"  # pragma: no cover
+
+
+# ---------------------------------------------------------------------------
+# pre-flight memory estimates (planner-side, no execution)
+# ---------------------------------------------------------------------------
+
+# per-row byte estimate for string/varbytes columns, whose content size
+# the schema cannot know (ir.STR_TYPE erases it): 12 bytes of average
+# content words + 4 of starts — deliberately a round planning number,
+# the measured ``bytes=`` column carries the truth
+STR_BYTES_EST = 16
+
+
+def _row_width_bytes(types: List[str]) -> int:
+    """Estimated bytes per row from a node's type strings: dtype
+    itemsize + 1 validity byte per column; strings at STR_BYTES_EST."""
+    w = 0
+    for t in types:
+        if t == ir.STR_TYPE:
+            w += STR_BYTES_EST
+        else:
+            try:
+                w += int(np.dtype(t).itemsize)
+            except TypeError:  # pragma: no cover - exotic type string
+                w += 8
+        w += 1  # validity / emit-mask share
+    return max(w, 1)
+
+
+def _scan_rows(node: "ir.Scan") -> Optional[int]:
+    t = node.table
+    if t is None and node.table_id is not None:
+        try:
+            from .. import table_api
+
+            t = table_api.get_table(node.table_id)
+        except Exception:
+            return None
+    return int(t.capacity) if t is not None else None
+
+
+def preflight_estimates(root: ir.PlanNode) -> Dict[int, dict]:
+    """``id(node) -> {"rows": int|None, "bytes": int|None}`` for every
+    plan node — schema widths × propagated row estimates, computed on
+    the host BEFORE execution. Deliberately simple upper-bound-ish
+    propagation (no key statistics exist): filters keep their input
+    rows, joins sum both sides, groupbys keep child rows. The point is
+    catching plans whose OUTPUT SCHEMA × input scale already exceeds
+    the comm budget — the class of OOM a pre-flight check can see."""
+    est: Dict[int, dict] = {}
+
+    def rows_of(node) -> Optional[int]:
+        kids = [est[id(c)]["rows"] for c in node.children]
+        if node.kind == "scan":
+            return _scan_rows(node)
+        if any(k is None for k in kids):
+            return None
+        if node.kind == "join":
+            return kids[0] + kids[1]
+        if node.kind == "setop":
+            if node.op == "subtract":
+                return kids[0]
+            if node.op == "intersect":
+                return min(kids)
+            return kids[0] + kids[1]
+        return kids[0]
+
+    for node in reversed(list(ir.walk(root))):  # children before parents
+        r = rows_of(node)
+        est[id(node)] = {
+            "rows": r,
+            "bytes": r * _row_width_bytes(node.types)
+            if r is not None else None,
+        }
+    return est
 
 
 @dataclass
@@ -63,6 +151,8 @@ class NodeMeasure:
     labels: List[str] = field(default_factory=list)  # own labels only
     children: List["NodeMeasure"] = field(default_factory=list)
     skew: Optional[dict] = None    # worst own-exchange skew (see below)
+    est_bytes: Optional[int] = None  # pre-flight output-size estimate
+    mem_warn: bool = False         # est_bytes exceeded the comm budget
 
     @property
     def shuffles(self) -> int:
@@ -80,9 +170,12 @@ class NodeMeasure:
                   f"min/med/max={self.skew['rows_min']}/"
                   f"{self.skew['rows_med']}/{self.skew['rows_max']})"
                   f"{warn}")
+        est = f", est={_human_bytes(self.est_bytes)}" \
+            if self.est_bytes is not None else ""
+        mem = "  [MEM]" if self.mem_warn else ""
         return (f"{self.desc}{pb}  (actual time={self.ms:.2f} ms, "
-                f"rows={self.rows}, bytes={_human_bytes(self.bytes)}, "
-                f"shuffles={self.shuffles}{sk})")
+                f"rows={self.rows}, bytes={_human_bytes(self.bytes)}"
+                f"{est}, shuffles={self.shuffles}{sk}){mem}")
 
     def to_dict(self) -> dict:
         return {
@@ -92,6 +185,7 @@ class NodeMeasure:
             "executed": self.executed,
             "ms": round(self.ms, 3) if self.ms is not None else None,
             "rows": self.rows, "bytes": self.bytes,
+            "est_bytes": self.est_bytes, "mem_warn": self.mem_warn,
             "shuffles": self.shuffles, "labels": list(self.labels),
             "skew": dict(self.skew) if self.skew is not None else None,
             "children": [c.to_dict() for c in self.children],
@@ -125,7 +219,9 @@ def _fold_skew(spans) -> Optional[dict]:
 
 def build_measures(node: ir.PlanNode, recs: Dict[int, object],
                    labels: List[str],
-                   spans: Optional[List[object]] = None) -> NodeMeasure:
+                   spans: Optional[List[object]] = None,
+                   est: Optional[Dict[int, dict]] = None,
+                   budget: Optional[int] = None) -> NodeMeasure:
     """Shape the executor's per-node records into a NodeMeasure tree.
 
     ``recs`` maps id(plan node) -> record with (i0, i1, ms, rows,
@@ -135,13 +231,19 @@ def build_measures(node: ir.PlanNode, recs: Dict[int, object],
     from the folding join's range. ``spans`` is the collector's Span
     list, index-aligned with ``labels`` (collect_phases appends both
     per entered span); the node's own ``shuffle.exchange*`` spans fold
-    into its ``skew`` summary."""
-    children = [build_measures(c, recs, labels, spans)
+    into its ``skew`` summary. ``est`` is the preflight_estimates map;
+    ``budget`` the comm budget the ``[MEM]`` marker compares against."""
+    children = [build_measures(c, recs, labels, spans, est, budget)
                 for c in node.children]
     r = recs.get(id(node))
+    e = (est or {}).get(id(node), {})
+    est_b = e.get("bytes")
     base = dict(kind=node.kind,
                 desc=f"{type(node).__name__}({node.args_repr()})",
-                partitioned_by=node.partitioned_by, children=children)
+                partitioned_by=node.partitioned_by, children=children,
+                est_bytes=est_b,
+                mem_warn=bool(budget) and est_b is not None
+                and est_b > budget)
     if r is None:
         return NodeMeasure(executed=False, **base)
     covered = [False] * (r.i1 - r.i0)
@@ -177,6 +279,8 @@ class PlanReport:
     #                                    executed with optimize=False)
     memory: dict = field(default_factory=dict)   # sampled HBM gauges
     metrics: dict = field(default_factory=dict)  # registry snapshot
+    leaks: List[dict] = field(default_factory=list)  # ledger leak report
+    budget: Optional[int] = None   # comm_budget_bytes at preflight
 
     def render(self) -> str:
         def fmt(m: NodeMeasure, indent: str = "") -> List[str]:
@@ -191,6 +295,11 @@ class PlanReport:
         lines.append(f"-- measured: {self.total_ms:.2f} ms total, "
                      f"{self.shuffle_count} exchange stage(s), "
                      f"world={self.world}")
+        for leak in self.leaks:
+            lines.append(
+                f"-- LEAK: {_human_bytes(leak['nbytes'])} "
+                f"owner={leak['owner']} span={leak['span']} "
+                f"(allocated under this query, never freed)")
         return "\n".join(lines)
 
     def to_dict(self) -> dict:
@@ -199,7 +308,10 @@ class PlanReport:
             "shuffle_count": self.shuffle_count,
             "world": self.world,
             "plan": self.root.to_dict(),
+            "leaks": [dict(leak) for leak in self.leaks],
         }
+        if self.budget is not None:
+            d["comm_budget_bytes"] = int(self.budget)
         if self.stats is not None:
             d["optimizer"] = {
                 "shuffles_inserted": self.stats.shuffles_inserted,

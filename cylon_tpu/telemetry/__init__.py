@@ -25,6 +25,15 @@ and grows it into a measurement layer:
 * ``profiler`` — opt-in kernel compile-cost capture hooked into
   ``counted_cache``: compile wall time + XLA cost analysis per factory
   program (``cylon_kernel_compile_seconds{factory=...}``).
+* ``ledger``  — buffer lifetime ledger: materializing ops register
+  alloc/free events with owner labels
+  (``cylon_live_table_bytes{owner=...}``), per-span HBM deltas ride
+  every span as ``hbm_delta``/``hbm_peak`` attrs, and the plan
+  executor renders an end-of-query leak report.
+* ``flight``  — query flight recorder: a bounded ring of recent root
+  span trees plus, on any exception crossing a root span, a JSON
+  crash dump (span stack, metrics snapshot, pool watermarks, ledger
+  outstanding set) written to ``CYLON_FLIGHT_DIR``.
 
 The plan executor builds per-query EXPLAIN ANALYZE reports
 (plan/report.py) on this layer; docs/telemetry.md documents the span
@@ -39,25 +48,28 @@ from __future__ import annotations
 
 from .spans import (Span, annotate, collect_phases, current_span,
                     log_to_stderr, logger, phase, span, add_sink,
-                    remove_sink)
+                    remove_sink, add_root_hook, remove_root_hook)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       REGISTRY, counted_cache, counter, gauge, histogram,
                       metrics_snapshot, record_host_sync, reset_metrics,
-                      sample_memory)
+                      sample_memory, set_memory_pool, get_memory_pool)
 from .export import JsonlSpanSink, prometheus_text, span_to_json
-from . import profiler, skew
+from . import ledger, profiler, skew
+from . import flight
 from .skew import SkewStats
 
 __all__ = [
     # spans
     "Span", "annotate", "collect_phases", "current_span", "log_to_stderr",
     "logger", "phase", "span", "add_sink", "remove_sink",
+    "add_root_hook", "remove_root_hook",
     # metrics
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "counted_cache", "counter", "gauge", "histogram", "metrics_snapshot",
     "record_host_sync", "reset_metrics", "sample_memory",
+    "set_memory_pool", "get_memory_pool",
     # exporters
     "JsonlSpanSink", "prometheus_text", "span_to_json",
-    # skew + compile-cost observability
-    "profiler", "skew", "SkewStats",
+    # skew + compile-cost + memory-lifetime + failure observability
+    "profiler", "skew", "SkewStats", "ledger", "flight",
 ]

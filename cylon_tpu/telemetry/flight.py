@@ -1,0 +1,186 @@
+"""Query flight recorder: a ring of recent query trees + crash dumps.
+
+An OOM or collective failure on an 8-wide mesh usually kills the whole
+controller process; the log line that would have explained it was never
+written. The flight recorder makes failures diagnosable post-mortem,
+the way an aircraft recorder does — always on, bounded, and dumped to
+disk the moment something goes wrong:
+
+* **ring** — the last ``CYLON_FLIGHT_RING`` (default 16) completed ROOT
+  span trees (whole queries / top-level eager ops), kept in memory via
+  a root-span close hook (spans.add_root_hook). ``recent()`` returns
+  them for interactive post-hoc inspection.
+* **crash dump** — when a root span closes with ``error=True`` and
+  ``CYLON_FLIGHT_DIR`` is set, ONE JSON file is written there
+  containing everything a post-mortem needs:
+
+  - the full span tree of the failed query (attrs included — the
+    ``hbm_delta``/``hbm_peak`` trail shows where memory went);
+  - the **error path**: root → deepest errored span, i.e. the exact
+    in-flight span stack at the moment the exception crossed each
+    frame (inner spans close first on a raise, each marked
+    ``error=True``);
+  - the metrics-registry snapshot (counters, per-phase latencies,
+    host-sync counts — everything docs/telemetry.md catalogs);
+  - MemoryPool watermarks (``snapshot()`` + available/comm budget —
+    ledger-backed on stats-hidden backends, so never blindly zero);
+  - the ledger's outstanding allocation set (which tables were live,
+    who allocated them, under which span);
+  - CYLON/JAX/XLA environment and the jax backend.
+
+Dumps are written only when ``CYLON_FLIGHT_DIR`` names a directory
+(checked at crash time, so tests/operators can arm it dynamically);
+the ring is always on and costs one deque append per root span.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import List, Optional
+
+from . import ledger as _ledger
+from . import metrics as _metrics
+from . import spans as _spans
+
+DUMP_SCHEMA_VERSION = 1
+
+DEFAULT_RING_SIZE = 16
+
+
+def _ring_size() -> int:
+    try:
+        return max(int(os.environ.get("CYLON_FLIGHT_RING",
+                                      DEFAULT_RING_SIZE)), 1)
+    except ValueError:  # pragma: no cover - defensive
+        return DEFAULT_RING_SIZE
+
+
+_ring: deque = deque(maxlen=_ring_size())
+_dump_seq = 0
+
+
+def recent() -> List[object]:
+    """The most recent completed root spans, oldest first."""
+    return list(_ring)
+
+
+def last_dump_path() -> Optional[str]:
+    """Path of the most recent crash dump this process wrote, or None."""
+    return getattr(_on_root_close, "_last_dump", None)
+
+
+def error_path(root) -> List[object]:
+    """Root → deepest errored descendant: the in-flight span stack at
+    failure time (on a raise, inner spans close first with error=True,
+    so the errored chain IS the stack the exception unwound)."""
+    out = []
+    node = root
+    while node is not None:
+        out.append(node)
+        nxt = None
+        for c in node.children:
+            if c.error:
+                nxt = c   # last errored child = innermost at unwind
+        node = nxt
+    return out
+
+
+def _pool_watermarks() -> dict:
+    pool = _metrics.get_memory_pool()
+    if pool is None:
+        return {}
+    try:
+        used, peak, limit = pool.snapshot()
+        return {"bytes_in_use": int(used), "peak_bytes": int(peak),
+                "bytes_limit": int(limit),
+                "available_bytes": pool.available_bytes(),
+                "comm_budget_bytes": pool.comm_budget_bytes()}
+    except Exception:  # pragma: no cover - defensive
+        return {}
+
+
+def _environment() -> dict:
+    import jax
+
+    env = {k: v for k, v in os.environ.items()
+           if k.startswith(("CYLON", "JAX_", "XLA_"))}
+    try:
+        backend = jax.default_backend()
+        n_devices = jax.device_count()
+    except Exception:  # pragma: no cover - defensive
+        backend, n_devices = None, None
+    return {"env": env, "backend": backend, "device_count": n_devices,
+            "pid": os.getpid()}
+
+
+def crash_dump_doc(root) -> dict:
+    """The crash-dump document for one errored root span (pure —
+    write_crash_dump serializes it; tests inspect it directly)."""
+    return {
+        "kind": "cylon-flight-crash-dump",
+        "version": DUMP_SCHEMA_VERSION,
+        "time_unix": time.time(),
+        "root_label": root.label,
+        "query": root.to_dict(nested=True),
+        "error_path": [s.to_dict() for s in error_path(root)],
+        "metrics": _metrics.metrics_snapshot(),
+        "pool": _pool_watermarks(),
+        "ledger_outstanding": _ledger.outstanding(),
+        "recent_queries": [s.label for s in _ring],
+        "environment": _environment(),
+    }
+
+
+def write_crash_dump(root, directory: Optional[str] = None
+                     ) -> Optional[str]:
+    """Serialize one errored root span tree to a single JSON file in
+    ``directory`` (default ``CYLON_FLIGHT_DIR``); returns the path, or
+    None when no directory is configured. Never raises — a failing
+    forensics path must not mask the original error."""
+    global _dump_seq
+    directory = directory or os.environ.get("CYLON_FLIGHT_DIR")
+    if not directory:
+        return None
+    try:
+        os.makedirs(directory, exist_ok=True)
+        _dump_seq += 1
+        name = (f"cylon-crash-{os.getpid()}-{_dump_seq:03d}-"
+                f"{root.name.replace('/', '_')}.json")
+        path = os.path.join(directory, name)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(crash_dump_doc(root), f, default=str, indent=2,
+                      sort_keys=True)
+        _spans.logger.warning("flight recorder: crash dump written to %s",
+                              path)
+        _on_root_close._last_dump = path
+        return path
+    except Exception:  # pragma: no cover - defensive
+        _spans.logger.exception("flight recorder: crash dump failed")
+        return None
+
+
+def _on_root_close(root) -> None:
+    if root.error:
+        # dump BEFORE ring insertion so recent_queries lists the
+        # queries that PRECEDED the failure
+        write_crash_dump(root)
+    if root.name == "plan.preflight":
+        # the default execute() path emits this warning marker as a
+        # parentless span; it is not a query tree — letting it into
+        # the ring would evict the real query history the forensics
+        # depend on
+        return
+    _ring.append(root)
+
+
+# always on: the hook costs one deque append per root span; dumps are
+# gated on CYLON_FLIGHT_DIR at crash time
+_spans.add_root_hook(_on_root_close)
+
+
+def reset() -> None:
+    """Clear the ring (test isolation); re-reads the ring-size env."""
+    global _ring
+    _ring = deque(maxlen=_ring_size())

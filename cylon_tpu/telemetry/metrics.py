@@ -215,6 +215,23 @@ def record_host_sync(site: str, n: int = 1) -> None:
     REGISTRY.counter("cylon_host_syncs_total", {"site": site}).inc(n)
 
 
+# Process-global MemoryPool handle (duck-typed — telemetry never
+# imports memory.py): CylonContext registers its pool here so the span
+# layer can sample per-span HBM deltas and the flight recorder can dump
+# watermarks without threading the pool through every call site. Last
+# registration wins (one pool per process in practice).
+_memory_pool = None
+
+
+def set_memory_pool(pool) -> None:
+    global _memory_pool
+    _memory_pool = pool
+
+
+def get_memory_pool():
+    return _memory_pool
+
+
 # Build hook for the compile-cost profiler (telemetry/profiler.py):
 # when installed, every counted_cache factory build passes its result
 # through ``hook(factory_name, built)`` so the profiler can wrap the

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import os
 import time
 from contextlib import contextmanager
 from contextvars import ContextVar
@@ -61,7 +62,22 @@ _collectors: list = []
 # Span as it CLOSES — the JSONL exporter registers here
 _sinks: List[Callable] = []
 
+# root-span close hooks: called with every span that closes with NO
+# parent (a whole query tree / top-level eager op). The flight recorder
+# (telemetry/flight.py) registers here to keep its completed-query ring
+# and to write crash dumps when a root span closes errored. Exceptions
+# are logged, never raised.
+_root_hooks: List[Callable] = []
+
 _span_ids = itertools.count(1)
+
+# per-span HBM sampling (hbm_delta/hbm_peak attrs): two pool snapshots
+# per span — a refcounted-counter read on ledger-backed pools, one
+# memory_stats runtime call per local device on stats-bearing
+# backends. CYLON_HBM_SPAN_ATTRS=0 turns it off for latency-critical
+# runs; the flight recorder's crash-time watermarks are unaffected
+# (sampled at dump time).
+_HBM_ATTRS = os.environ.get("CYLON_HBM_SPAN_ATTRS", "1") != "0"
 
 # innermost open span of the current (async/thread) context, or None
 _current: ContextVar[Optional["Span"]] = ContextVar(
@@ -83,9 +99,11 @@ class Span:
     children: List["Span"] = field(default_factory=list)
     span_id: int = 0
     parent_id: int = 0
+    root_id: int = 0               # the enclosing tree's root span_id
     elapsed_ms: Optional[float] = None
     error: bool = False
     _t0: float = 0.0
+    _hbm0: Optional[int] = None    # pool bytes_in_use at span enter
 
     @property
     def label(self) -> str:
@@ -106,7 +124,7 @@ class Span:
         """Flat JSON-able record (parent_id links the tree); pass
         ``nested=True`` to embed children instead."""
         d = {"span_id": self.span_id, "parent_id": self.parent_id,
-             "name": self.name, "seq": self.seq,
+             "root_id": self.root_id, "name": self.name, "seq": self.seq,
              "elapsed_ms": self.elapsed_ms, "error": self.error,
              "attrs": dict(self.attrs)}
         if nested:
@@ -132,6 +150,20 @@ def add_sink(sink: Callable) -> None:
     """Register a completed-span sink: ``sink(span)`` runs as each span
     closes (innermost first). Exceptions are logged, never raised."""
     _sinks.append(sink)
+
+
+def add_root_hook(hook: Callable) -> None:
+    """Register a root-span close hook: ``hook(span)`` runs when a span
+    with no parent closes — the whole tree is complete at that point
+    (children closed first). The flight recorder lives here."""
+    _root_hooks.append(hook)
+
+
+def remove_root_hook(hook: Callable) -> None:
+    for i, h in enumerate(_root_hooks):
+        if h is hook:
+            del _root_hooks[i]
+            break
 
 
 def remove_sink(sink: Callable) -> None:
@@ -193,12 +225,24 @@ def span(name: str, seq: Optional[int] = None, **attrs) -> Iterator[Span]:
     parent = _current.get()
     s = Span(name, seq, dict(attrs), span_id=next(_span_ids),
              parent_id=parent.span_id if parent is not None else 0)
+    s.root_id = parent.root_id if parent is not None else s.span_id
     label = s.label
     for c in _collectors:
         c.labels.append(label)
         c.spans.append(s)
     if parent is not None:
         parent.children.append(s)
+    # per-span HBM accounting: snapshot the registered pool (duck-typed
+    # — metrics.set_memory_pool) at enter and exit so every span carries
+    # hbm_delta/hbm_peak attrs. On backends that hide memory_stats the
+    # pool reads the ledger's tracked bytes, so the attrs stay live
+    # through the axon tunnel and on the CPU test mesh.
+    pool = _metrics.get_memory_pool() if _HBM_ATTRS else None
+    if pool is not None:
+        try:
+            s._hbm0 = int(pool.snapshot()[0])
+        except Exception:  # pragma: no cover - defensive
+            s._hbm0 = None
     token = _current.set(s)
     s._t0 = time.perf_counter()
     try:
@@ -211,12 +255,25 @@ def span(name: str, seq: Optional[int] = None, **attrs) -> Iterator[Span]:
     finally:
         s.elapsed_ms = (time.perf_counter() - s._t0) * 1e3
         _current.reset(token)
+        if s._hbm0 is not None:
+            try:
+                used, peak, _limit = pool.snapshot()
+                s.attrs["hbm_delta"] = int(used) - s._hbm0
+                s.attrs["hbm_peak"] = int(peak)
+            except Exception:  # pragma: no cover - defensive
+                pass
         _metrics.observe_phase(s.name, s.elapsed_ms, error=s.error)
         for sink in list(_sinks):
             try:
                 sink(s)
             except Exception:  # pragma: no cover - defensive
                 logger.exception("span sink failed")
+        if parent is None:
+            for hook in list(_root_hooks):
+                try:
+                    hook(s)
+                except Exception:  # pragma: no cover - defensive
+                    logger.exception("root-span hook failed")
         if logger.isEnabledFor(logging.INFO):
             logger.info("%s %.3f ms%s", label, s.elapsed_ms,
                         " error=True" if s.error else "")

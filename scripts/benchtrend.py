@@ -59,6 +59,10 @@ def load_rounds(directory: str, pattern: str = "BENCH_r*.json"
             doc = json.load(open(path, encoding="utf-8"))
         except (OSError, json.JSONDecodeError):
             doc = {}
+        if not isinstance(doc, dict):
+            # an empty/foreign trajectory state ("[]", a bare string...)
+            # is a round with nothing parseable, not a crash
+            doc = {}
         parsed = doc.get("parsed")
         backend = None
         if isinstance(parsed, dict):
@@ -123,7 +127,13 @@ def render_table(rounds: List[dict]) -> str:
     per_round = [(r, flatten_metrics(r["parsed"])) for r in rounds]
     metrics = sorted({m for _r, f in per_round for m in f})
     if not metrics:
-        return "benchtrend: no parseable BENCH artifacts"
+        lines = ["benchtrend: no parseable BENCH artifacts"]
+        for r in rounds:
+            if r["parsed"] is None:
+                lines.append(f"note: r{r['round']:02d} has no parsed "
+                             f"artifact (driver rc!=0 or foreign "
+                             f"state) — skipped")
+        return "\n".join(lines)
     ref = reference_round(rounds)
     latest = latest_parsed(rounds)
     flat_by_round = {r["round"]: f for r, f in per_round}
@@ -221,9 +231,16 @@ def main(argv=None) -> int:
     a = p.parse_args(argv)
 
     rounds = load_rounds(a.dir, a.glob)
-    regressions = find_regressions(rounds, a.threshold)
+    # empty/unparseable trajectory (a fresh repo, an external trend
+    # state of "[]"): nothing to gate against — --check passes with an
+    # explicit note rather than crashing; render/--json still list
+    # whatever round records exist so an operator can see WHICH rounds
+    # stopped parsing
+    no_baseline = latest_parsed(rounds) is None
+    regressions = [] if no_baseline else \
+        find_regressions(rounds, a.threshold)
     if a.json:
-        print(json.dumps({
+        doc = {
             "rounds": [{"round": r["round"], "backend": r["backend"],
                         "metrics": flatten_metrics(r["parsed"])}
                        for r in rounds],
@@ -232,9 +249,15 @@ def main(argv=None) -> int:
                 {"metric": m, "latest": nv, "reference": rv,
                  "drop": round(d, 4)}
                 for m, nv, rv, d in regressions],
-        }, indent=2, sort_keys=True))
+        }
+        if no_baseline:
+            doc["note"] = "no baseline yet"
+        print(json.dumps(doc, indent=2, sort_keys=True))
     else:
         print(render_table(rounds))
+        if no_baseline:
+            print("benchtrend: no baseline yet — no parseable BENCH "
+                  "artifact in the trajectory; gate passes vacuously")
     if regressions:
         for m, nv, rv, d in regressions:
             print(f"benchtrend: REGRESSION {m}: {_human(nv)} is "
@@ -243,7 +266,7 @@ def main(argv=None) -> int:
                   file=sys.stderr)
         if a.check:
             return 1
-    elif a.check:
+    elif a.check and not no_baseline:
         print("benchtrend: OK — no metric regressed beyond "
               f"{a.threshold * 100:.0f}%")
     return 0

@@ -5,16 +5,20 @@ set -e
 cd "$(dirname "$0")/.."
 
 echo "== static analysis: python -m cylon_tpu.analysis =="
-# all five checker families (layering, hostsync, collectives, witness,
-# span-coverage); any unsuppressed finding fails the gate before tests
+# all six checker families (layering, hostsync, collectives, witness,
+# span-coverage, ledger-coverage); any unsuppressed finding fails the
+# gate before tests
 python -m cylon_tpu.analysis
 
 echo "== telemetry smoke: scripts/smoke_telemetry.py =="
 # a two-shuffle pipeline must produce a parseable JSONL trace (with
-# per-exchange skew attributes), a Prometheus dump with nonzero
-# shuffle_bytes_total + per-shard shuffle histograms + kernel
-# compile-seconds, and an EXPLAIN ANALYZE report whose shuffle count
-# matches the phase labels and whose Shuffle nodes carry skew stats
+# per-exchange skew attributes AND per-span hbm_delta/hbm_peak attrs),
+# a Prometheus dump with nonzero shuffle_bytes_total + per-shard
+# shuffle histograms + kernel compile-seconds + live-table-bytes
+# gauges, an EXPLAIN ANALYZE report whose shuffle count matches the
+# phase labels with skew + pre-flight est= columns and zero leaks; a
+# deliberately failing query must leave a parseable crash dump (span
+# stack, metrics, nonzero pool watermark, ledger outstanding set)
 python scripts/smoke_telemetry.py
 
 echo "== bench trend: scripts/benchtrend.py --check =="

@@ -18,7 +18,18 @@ verifies the observability layer end to end:
   compile-cost profiler;
 * ``explain(analyze=True)`` renders per-node measured rows, its
   reported shuffle count equals ``collect_phases.count("plan.shuffle")``,
-  and its exchange-bearing nodes render ``skew(...)`` columns.
+  its exchange-bearing nodes render ``skew(...)`` columns, and every
+  node carries the planner's pre-flight ``est=...`` bytes beside the
+  measured bytes;
+* the MEMORY half of the observatory is live: spans carry
+  ``hbm_delta``/``hbm_peak`` attrs (ledger-backed pool on the CPU
+  mesh), ``cylon_live_table_bytes`` gauges render, and the query leaks
+  nothing;
+* the FLIGHT RECORDER works under fire: a deliberately failing query
+  (injected exchange failure) writes a single-file JSON crash dump to
+  ``CYLON_FLIGHT_DIR`` that parses, carries the in-flight
+  ``plan.shuffle*`` span in its error path, a NONZERO pool watermark,
+  the metrics snapshot, and the ledger's outstanding set.
 
 Exit 0 on success; any failure prints the offending artifact and exits
 non-zero, failing the gate.
@@ -114,12 +125,27 @@ def main() -> None:
         fail(f"explain(analyze=True) missing measurements:\n{txt}")
     if "skew(imb=" not in txt:
         fail(f"explain(analyze=True) missing skew columns:\n{txt}")
+    if "est=" not in txt:
+        fail(f"explain(analyze=True) missing pre-flight est= bytes:\n"
+             f"{txt}")
     if rep.shuffle_count != cp.count("plan.shuffle"):
         fail(f"report shuffle_count {rep.shuffle_count} != "
              f"collect_phases {cp.count('plan.shuffle')}")
     if rep.shuffle_count != 2:
         fail(f"two-shuffle pipeline reported {rep.shuffle_count} "
              f"exchanges:\n{txt}")
+    if rep.leaks:
+        fail(f"clean pipeline reported ledger leaks: {rep.leaks}")
+
+    # -- memory observatory: per-span HBM attrs ride the trace --------
+    hbm_spans = [r for r in recs if "hbm_delta" in r["attrs"]
+                 and "hbm_peak" in r["attrs"]]
+    if not hbm_spans:
+        fail("no span in the trace carries hbm_delta/hbm_peak attrs "
+             "(pool not registered, or ledger fallback dead)")
+    if max(r["attrs"]["hbm_peak"] for r in hbm_spans) <= 0:
+        fail("hbm_peak is zero across the whole trace — the ledger-"
+             "backed pool fallback is not accounting")
 
     # -- Prometheus dump: renders, counters wired ---------------------
     prom = telemetry.prometheus_text()
@@ -135,18 +161,78 @@ def main() -> None:
                    "cylon_shuffle_shard_bytes_bucket",
                    "cylon_shuffle_imbalance_factor_bucket",
                    "cylon_kernel_compile_seconds_bucket",
-                   "cylon_host_syncs_total"):
+                   "cylon_host_syncs_total",
+                   "cylon_live_table_bytes"):
         if series not in prom:
             fail(f"{series} missing from Prometheus dump")
     n_compiles = len(profiler.records())
     if n_compiles == 0:
         fail("compile-cost profiler recorded no programs")
 
+    # -- flight recorder: a failing query leaves a crash dump ---------
+    dump = crash_dump_smoke(ct, plan, left)
+
     print(f"telemetry smoke: OK — {len(recs)} spans traced, "
           f"{rep.shuffle_count} exchanges measured, "
           f"{bytes_lines[0].split()[1]} shuffle bytes counted, "
           f"{len(ex_spans)} exchange span(s) with skew attrs, "
-          f"{n_compiles} kernel compile(s) profiled")
+          f"{len(hbm_spans)} span(s) with hbm attrs, "
+          f"{n_compiles} kernel compile(s) profiled, "
+          f"crash dump at {dump}")
+
+
+def crash_dump_smoke(ct, plan, left) -> str:
+    """Force a failing query under the flight recorder: inject an
+    exchange failure into an explicit Shuffle plan, assert the crash
+    dump is written to CYLON_FLIGHT_DIR, parses as JSON, and carries
+    the in-flight plan.shuffle span, a nonzero pool watermark, the
+    metrics snapshot and the ledger outstanding set."""
+    from cylon_tpu.parallel import dist_ops
+
+    flight_dir = tempfile.mkdtemp()
+    os.environ["CYLON_FLIGHT_DIR"] = flight_dir
+
+    orig = dist_ops.shuffle
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected exchange failure (smoke)")
+
+    dist_ops.shuffle = boom
+    try:
+        try:
+            plan.scan(left).shuffle("k").execute(analyze=True)
+        except RuntimeError:
+            pass
+        else:
+            fail("injected exchange failure did not raise")
+    finally:
+        dist_ops.shuffle = orig
+        os.environ.pop("CYLON_FLIGHT_DIR", None)
+
+    dumps = [f for f in os.listdir(flight_dir) if f.endswith(".json")]
+    if len(dumps) != 1:
+        fail(f"expected exactly one crash dump in {flight_dir}, "
+             f"found {dumps}")
+    path = os.path.join(flight_dir, dumps[0])
+    try:
+        doc = json.load(open(path, encoding="utf-8"))
+    except json.JSONDecodeError as e:
+        fail(f"crash dump does not parse as JSON: {e}")
+    for key in ("query", "error_path", "metrics", "pool",
+                "ledger_outstanding", "environment"):
+        if key not in doc:
+            fail(f"crash dump lacks {key!r}: {sorted(doc)}")
+    names = [s["name"] for s in doc["error_path"]]
+    if not any(n.startswith("plan.shuffle") for n in names):
+        fail(f"crash dump error path lacks the in-flight plan.shuffle "
+             f"span: {names}")
+    if not doc["pool"].get("bytes_in_use", 0) > 0:
+        fail(f"crash dump pool watermark is zero (ledger fallback "
+             f"dead): {doc['pool']}")
+    if not doc["ledger_outstanding"]:
+        fail("crash dump has an empty ledger outstanding set — the "
+             "in-flight scan inputs should be live")
+    return path
 
 
 if __name__ == "__main__":

@@ -1,16 +1,23 @@
-"""Fixture distributed ops for the span-coverage checker: one spanned
-op (clean), one bare op (seeded), plus a private helper and a
-non-distributed public function — both outside the contract."""
-from ..telemetry import phase as _phase
+"""Fixture distributed ops for the span/ledger-coverage checkers: one
+fully instrumented op (clean), one bare op (seeded for BOTH families),
+one spanned-but-untracked op (seeded for ledger-coverage only), plus a
+private helper and a non-distributed public function — both outside the
+contracts."""
+from ..telemetry import ledger as _ledger, phase as _phase
 
 
 def distributed_spanned(t):
     with _phase("distributed_spanned.work", 0):
-        return t
+        return _ledger.track(t, "distributed_spanned")
 
 
-def distributed_bare(t):  # SEEDED: span-coverage/missing-span
+def distributed_bare(t):  # SEEDED: span-coverage + ledger-coverage
     return t + 1
+
+
+def distributed_untracked(t):  # SEEDED: ledger-coverage/missing-ledger
+    with _phase("distributed_untracked.work", 0):
+        return t
 
 
 def _helper(t):  # private: outside the contract

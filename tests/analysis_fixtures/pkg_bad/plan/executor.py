@@ -1,15 +1,20 @@
-"""Fixture executor for the span-coverage checker: one spanned lowering
-(clean), one bare lowering (seeded)."""
-from ..telemetry import phase as _phase
+"""Fixture executor for the span/ledger-coverage checkers: one fully
+instrumented lowering (clean), one bare lowering (seeded for both
+families), one spanned-but-untracked lowering (ledger-coverage only)."""
+from ..telemetry import ledger as _ledger, phase as _phase
 
 
 class _Exec:
     def _do_spanned(self, node):
         with _phase("plan.spanned"):
-            return node
+            return _ledger.track(node, "plan.spanned")
 
-    def _do_bare(self, node):  # SEEDED: span-coverage/missing-span
+    def _do_bare(self, node):  # SEEDED: span-coverage + ledger-coverage
         return node
+
+    def _do_untracked(self, node):  # SEEDED: ledger-coverage
+        with _phase("plan.untracked"):
+            return node
 
     def run(self, node):  # not a _do_* lowering: outside the contract
         return node

@@ -75,8 +75,8 @@ def test_spancov_fixture_reports_exactly_seeded():
                        families=["span-coverage"])
     got = {(f.path, f.line, f.rule) for f in res.findings}
     assert got == {
-        ("parallel/dist_ops.py", 12, "span-coverage/missing-span"),
-        ("plan/executor.py", 11, "span-coverage/missing-span"),
+        ("parallel/dist_ops.py", 14, "span-coverage/missing-span"),
+        ("plan/executor.py", 12, "span-coverage/missing-span"),
     }, res.format_text()
     # private helpers / non-distributed_* / non-_do_* stay out of scope
     msgs = " ".join(f.message for f in res.findings)
@@ -89,6 +89,36 @@ def test_spancov_real_tree_clean():
     contract the EXPLAIN ANALYZE acceptance rests on."""
     res = run_checkers(AnalysisContext(PKG_REAL),
                        families=["span-coverage"])
+    assert res.findings == [], res.format_text()
+
+
+# ---------------------------------------------------------------------------
+# ledger-coverage
+# ---------------------------------------------------------------------------
+
+
+def test_ledgercov_fixture_reports_exactly_seeded():
+    """The memory analog of span-coverage: the bare op fails BOTH
+    families, the spanned-but-untracked ones fail only the ledger."""
+    res = run_checkers(AnalysisContext(PKG_BAD),
+                       families=["ledger-coverage"])
+    got = {(f.path, f.line, f.rule) for f in res.findings}
+    assert got == {
+        ("parallel/dist_ops.py", 14, "ledger-coverage/missing-ledger"),
+        ("parallel/dist_ops.py", 18, "ledger-coverage/missing-ledger"),
+        ("plan/executor.py", 12, "ledger-coverage/missing-ledger"),
+        ("plan/executor.py", 15, "ledger-coverage/missing-ledger"),
+    }, res.format_text()
+    msgs = " ".join(f.message for f in res.findings)
+    assert "_helper" not in msgs and "repartition_like" not in msgs
+
+
+def test_ledgercov_real_tree_clean():
+    """Every materializing distributed_* op and every executor lowering
+    registers its output with the telemetry ledger — the attribution
+    contract the leak report and crash-dump forensics rest on."""
+    res = run_checkers(AnalysisContext(PKG_REAL),
+                       families=["ledger-coverage"])
     assert res.findings == [], res.format_text()
 
 

@@ -144,6 +144,50 @@ def test_cli_check_exit_codes(tmp_path):
     assert [r["round"] for r in doc["rounds"]] == [1, 2]
 
 
+def test_empty_trajectory_is_no_baseline_not_a_crash(tmp_path):
+    """A fresh repo / an external trend state of "[]": load_rounds must
+    tolerate non-dict JSON and --check must exit 0 with an explicit
+    'no baseline yet' note instead of crashing."""
+    # non-dict JSON documents (the observed external state) and garbage
+    (tmp_path / "BENCH_r01.json").write_text("[]")
+    (tmp_path / "BENCH_r02.json").write_text("not json at all {{{")
+    rounds = benchtrend.load_rounds(str(tmp_path))
+    assert [r["round"] for r in rounds] == [1, 2]
+    assert all(r["parsed"] is None for r in rounds)
+    assert benchtrend.latest_parsed(rounds) is None
+    assert benchtrend.find_regressions(rounds) == []
+    r = subprocess.run(
+        [sys.executable, SCRIPT, "--dir", str(tmp_path), "--check"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no baseline yet" in r.stdout
+    # the per-round listing survives: an operator can still see WHICH
+    # rounds stopped parsing
+    assert "r01" in r.stdout and "r02" in r.stdout
+    js = subprocess.run(
+        [sys.executable, SCRIPT, "--dir", str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=60)
+    doc = json.loads(js.stdout)
+    assert doc["note"] == "no baseline yet"
+    assert [r["round"] for r in doc["rounds"]] == [1, 2]
+
+
+def test_empty_directory_check_passes(tmp_path):
+    """No BENCH artifacts at all — the gate passes vacuously, in both
+    text and JSON form."""
+    r = subprocess.run(
+        [sys.executable, SCRIPT, "--dir", str(tmp_path), "--check"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no baseline yet" in r.stdout
+    js = subprocess.run(
+        [sys.executable, SCRIPT, "--dir", str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=60)
+    doc = json.loads(js.stdout)
+    assert doc == {"rounds": [], "threshold": 0.2, "regressions": [],
+                   "note": "no baseline yet"}
+
+
 def test_cli_over_committed_artifacts():
     """The repo's own BENCH_r01–r05 trajectory renders and passes the
     gate (r05 is a cpu-fallback round with no same-backend reference)."""

@@ -757,3 +757,46 @@ def test_exact_redo_schema_and_free(dist_ctx):
     assert left2.column_count == 0, "retain=False input not freed"
     assert right2.column_count == 2, "retained input wrongly freed"
     assert_rows_equal(res.to_pandas(), exp, msg="exact redo vs normal")
+
+
+def test_exact_redo_ledger_zero_outstanding_unretained(dist_ctx):
+    """Leak-ledger regression pin for the collision-recovery path
+    (ADVICE r5): after _exact_dict_redo, the ledger must show ZERO
+    outstanding unretained inputs — the redo's deferred
+    _free_if_unretained must reach Table.clear() and retire the
+    entry. If the PR-1 free ever regresses, this fails before any HBM
+    graph would show it."""
+    from cylon_tpu.ops.join import JoinAlgorithm, JoinConfig, JoinType
+    from cylon_tpu.parallel.dist_ops import _exact_dict_redo
+    from cylon_tpu.telemetry import ledger
+
+    rng = np.random.default_rng(47)
+    n = 300
+    pool = [f"redo-{i:04d}-" + "z" * 24 for i in range(48)]
+
+    def make(lo, hi, name):
+        ks = np.array([pool[i] for i in rng.integers(lo, hi, n)], object)
+        from cylon_tpu.data.column import Column
+        from cylon_tpu.data.strings import VarBytes
+        from cylon_tpu.data.table import Table
+
+        return Table([
+            Column.from_varbytes(VarBytes.from_host(list(ks)), None, "k"),
+            Column.from_numpy(np.arange(n) + lo, name)], dist_ctx)
+
+    left = make(0, 32, "v")
+    right = make(16, 48, "w")
+    left.retain_memory(False)
+    ledger.track(left, "redo_input_unretained")
+    ledger.track(right, "redo_input_retained")
+    cfg = JoinConfig(JoinType.LEFT, [0], [0], JoinAlgorithm.SORT,
+                     exact=True)
+    res = _exact_dict_redo(left, right, cfg, [(0, 0)],
+                           force_exchange=False)
+    assert res.row_count > 0
+    owners = [e["owner"] for e in ledger.outstanding()]
+    assert "redo_input_unretained" not in owners, \
+        "unretained input survived collision recovery in the ledger"
+    # the retained input (still referenced here) must NOT have retired
+    assert "redo_input_retained" in owners
+    right.clear()   # tidy the global ledger for later tests

@@ -1,0 +1,362 @@
+"""Memory-lifetime observatory tests: the buffer lifetime ledger
+(alloc/free events, owner gauges, leak reports), per-span HBM attrs,
+the planner's pre-flight memory estimates ([MEM] marker + warning
+span), and the flight recorder (query ring + crash dumps)."""
+import gc
+import glob
+import json
+
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import plan, telemetry
+from cylon_tpu.telemetry import flight, ledger
+
+
+def _table(ctx, n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    return ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, max(n // 4, 1), n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32)})
+
+
+def _gauge_value(owner):
+    return telemetry.metrics_snapshot().get(
+        f'cylon_live_table_bytes{{owner="{owner}"}}', 0)
+
+
+# ---------------------------------------------------------------------------
+# ledger events
+# ---------------------------------------------------------------------------
+
+
+def test_track_release_and_gauge(local_ctx):
+    t = _table(local_ctx)
+    owner = "test_track_release"
+    before = _gauge_value(owner)
+    assert ledger.track(t, owner) is t
+    assert _gauge_value(owner) - before == t.nbytes
+    assert any(e["owner"] == owner for e in ledger.outstanding())
+    # explicit free event (Table.clear — the _free_if_unretained path)
+    t.clear()
+    assert _gauge_value(owner) == before
+    assert not any(e["owner"] == owner for e in ledger.outstanding())
+
+
+def test_gc_retires_entries(local_ctx):
+    t = _table(local_ctx)
+    owner = "test_gc_retire"
+    before_live = ledger.live_bytes()
+    ledger.track(t, owner)
+    assert ledger.live_bytes() - before_live == t.nbytes
+    del t
+    gc.collect()
+    assert ledger.live_bytes() == before_live
+    assert _gauge_value(owner) == 0
+
+
+def test_retrack_reattributes_owner(local_ctx):
+    """A dist op tracks its result, then the executor re-tracks it
+    under the plan.* label — bytes must MOVE between gauges, not
+    double-count, and the entry must retire exactly once."""
+    t = _table(local_ctx)
+    a0, b0 = _gauge_value("retrack_a"), _gauge_value("retrack_b")
+    live0 = ledger.live_bytes()
+    ledger.track(t, "retrack_a")
+    ledger.track(t, "retrack_b")
+    assert _gauge_value("retrack_a") == a0
+    assert _gauge_value("retrack_b") - b0 == t.nbytes
+    assert ledger.live_bytes() - live0 == t.nbytes  # no double count
+    t.clear()
+    assert _gauge_value("retrack_b") == b0
+    assert ledger.live_bytes() == live0
+
+
+def test_release_unknown_table_is_noop(local_ctx):
+    assert ledger.release(_table(local_ctx)) is False
+    assert ledger.release(None) is False
+
+
+def test_shared_buffer_views_do_not_double_count(local_ctx):
+    """Zero-copy project/filter views refcount their shared buffers:
+    live_bytes grows by at most the view's NEW buffers (the filter
+    mask), never by another full table footprint."""
+    t = _table(local_ctx, n=4096)
+    live0 = ledger.live_bytes()
+    ledger.track(t, "view_base")
+    base = ledger.live_bytes() - live0
+    assert base == t.nbytes
+    view = t.project([0])                   # shares column 0 outright
+    ledger.track(view, "view_proj")
+    assert ledger.live_bytes() - live0 == base  # nothing new allocated
+    filt = t.filter_mask(t._columns[0].data > 0)
+    ledger.track(filt, "view_filt")
+    grew = ledger.live_bytes() - live0 - base
+    assert 0 < grew < t.nbytes // 2         # only the new row mask
+    # entry footprints (what a leak pins) still report full nbytes
+    by_owner = {e["owner"]: e for e in ledger.outstanding()}
+    assert by_owner["view_proj"]["nbytes"] == view.nbytes
+    # releases unwind refcounts back to the baseline
+    filt.clear()
+    view.clear()
+    t.clear()
+    assert ledger.live_bytes() == live0
+
+
+def test_retrack_borrowed_is_sticky(local_ctx):
+    """A prior query's result re-entering a new query as a Scan input
+    is user-held: re-tracking it borrowed under the new root must not
+    turn it into a false leak (review finding)."""
+    t = _table(local_ctx)
+    with telemetry.span("plan.query") as root1:
+        ledger.track(t, "plan.join")        # query 1 allocated it
+    with telemetry.span("plan.query") as root2:
+        ledger.track(t, "plan.scan", borrowed=True)  # query 2 scans it
+    assert ledger.leak_report(root2.span_id) == []
+    # and it left query 1's root when re-rooted — no stale report there
+    assert ledger.leak_report(root1.span_id) == []
+    t.clear()
+
+
+# ---------------------------------------------------------------------------
+# leak report (the acceptance scenario: retained-and-dropped)
+# ---------------------------------------------------------------------------
+
+
+def test_leak_report_lists_retained_and_dropped_table(dist_ctx):
+    """A table materialized under the query's root span and still
+    referenced at query end is a leak; a freed intermediate and the
+    borrowed scan input are not."""
+    from cylon_tpu.parallel import dist_ops
+
+    src = _table(dist_ctx, n=1024, seed=3)
+    with telemetry.span("plan.query") as root:
+        ledger.track(src, "plan.scan", borrowed=True)  # scan input
+        leaked = dist_ops.shuffle(src, ["k"])      # kept alive below
+        tmp = dist_ops.shuffle(leaked, ["v"])      # freed intermediate
+        del tmp
+        gc.collect()
+    leaks = ledger.leak_report(root.span_id)
+    assert len(leaks) == 1, leaks
+    assert leaks[0]["owner"] == "shuffle"
+    assert leaks[0]["nbytes"] == leaked.nbytes
+    assert leaks[0]["root_id"] == root.span_id
+    # the leaked table shows in the owner gauge too
+    assert _gauge_value("shuffle") >= leaked.nbytes
+    # excluding it (the "this is my query result" case) empties the report
+    assert ledger.leak_report(root.span_id,
+                              exclude={id(leaked)}) == []
+
+
+def test_executor_clean_query_reports_no_leaks(dist_ctx):
+    left, right = _table(dist_ctx, seed=1), _table(dist_ctx, seed=2)
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    pipe.execute(analyze=True)
+    rep = pipe.last_report
+    assert rep.leaks == [], rep.render()
+    assert "LEAK" not in rep.render()
+    assert "leaks" in rep.to_dict() and rep.to_dict()["leaks"] == []
+
+
+def test_report_renders_leak_lines(dist_ctx):
+    """PlanReport.render carries one -- LEAK line per outstanding
+    entry (checked via a synthetic report — executor integration is
+    the previous test)."""
+    from cylon_tpu.plan.report import NodeMeasure, PlanReport
+
+    rep = PlanReport(
+        root=NodeMeasure(kind="scan", desc="Scan()", partitioned_by=None,
+                         executed=True, ms=1.0, rows=1, bytes=8),
+        span=None, shuffle_count=0, total_ms=1.0, world=1,
+        leaks=[{"owner": "plan.filter", "nbytes": 2048,
+                "span": "plan.filter#9", "event_id": 1, "root_id": 5,
+                "borrowed": False, "age_s": 0.1}])
+    txt = rep.render()
+    assert "LEAK" in txt and "plan.filter" in txt and "2.0 KiB" in txt
+    assert rep.to_dict()["leaks"][0]["owner"] == "plan.filter"
+
+
+# ---------------------------------------------------------------------------
+# per-span HBM attrs
+# ---------------------------------------------------------------------------
+
+
+def test_spans_carry_hbm_delta_and_peak(dist_ctx):
+    """With a registered pool (ledger-backed on the CPU mesh), every
+    span gains hbm_delta/hbm_peak; tracking inside the span makes the
+    delta positive."""
+    t = _table(dist_ctx, n=2048, seed=7)
+    with telemetry.span("hbm.test") as sp:
+        ledger.track(t, "hbm_attr_test")
+    assert "hbm_delta" in sp.attrs and "hbm_peak" in sp.attrs
+    # concurrent GC of earlier tables can only shrink the delta; the
+    # fresh track dominates
+    assert sp.attrs["hbm_delta"] > 0
+    assert sp.attrs["hbm_peak"] >= t.nbytes
+    t.clear()
+
+
+def test_explain_analyze_shows_est_and_hbm(dist_ctx):
+    """Acceptance: a two-shuffle pipeline's EXPLAIN ANALYZE shows
+    per-node est_bytes, and the query's span tree carries hbm_delta
+    attrs."""
+    rng = np.random.default_rng(0)
+    n = 2048
+    left = ct.Table.from_pydict(dist_ctx, {
+        "k": rng.integers(0, n // 4, n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32),
+        "z": rng.integers(0, 50, n).astype(np.int32)})
+    right = ct.Table.from_pydict(dist_ctx, {
+        "k": rng.integers(0, n // 4, n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32)})
+    pipe = plan.scan(left).join(plan.scan(right), on="k") \
+        .groupby("lt-2", ["rt-4"], ["sum"])
+    txt = pipe.explain(analyze=True)
+    rep = pipe.last_report
+    assert rep.shuffle_count == 2
+    assert "est=" in txt, txt
+    # every executed node rendered an estimate
+    def walk(m):
+        yield m
+        for c in m.children:
+            yield from walk(c)
+    for m in walk(rep.root):
+        if m.executed:
+            assert m.est_bytes is not None and m.est_bytes > 0, m.desc
+            assert m.to_dict()["est_bytes"] == m.est_bytes
+    hbm_spans = [s for s in rep.span.walk() if "hbm_delta" in s.attrs]
+    assert hbm_spans, "no span in the query tree carries hbm_delta"
+    assert max(s.attrs["hbm_peak"] for s in hbm_spans) > 0
+
+
+# ---------------------------------------------------------------------------
+# pre-flight memory estimates
+# ---------------------------------------------------------------------------
+
+
+def test_preflight_estimate_propagation(dist_ctx):
+    from cylon_tpu.plan.report import (STR_BYTES_EST, _row_width_bytes,
+                                       preflight_estimates)
+
+    left, right = _table(dist_ctx, n=100, seed=1), \
+        _table(dist_ctx, n=50, seed=2)
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    est = preflight_estimates(pipe._node)
+    node = pipe._node
+    l_scan, r_scan = node.children
+    assert est[id(l_scan)]["rows"] == 100
+    assert est[id(r_scan)]["rows"] == 50
+    assert est[id(node)]["rows"] == 150           # join: l + r
+    # width: int32(4)+f32(4) + 2 validity bytes = 10 per row
+    assert est[id(l_scan)]["bytes"] == 100 * 10
+    assert est[id(node)]["bytes"] == 150 * 20
+    # string columns estimate at the documented planning constant
+    assert _row_width_bytes(["str"]) == STR_BYTES_EST + 1
+    # groupby/filter keep child rows (upper bound, no key stats)
+    gb = pipe.groupby(0, [1], ["sum"])
+    est2 = preflight_estimates(gb._node)
+    assert est2[id(gb._node)]["rows"] == 150
+
+
+def test_mem_marker_and_preflight_warning_span(dist_ctx, monkeypatch):
+    """With a (forced) tiny comm budget, beyond-budget nodes render
+    [MEM] and the executor emits ONE pre-execution plan.preflight
+    warning span."""
+    left, right = _table(dist_ctx, n=2048, seed=1), \
+        _table(dist_ctx, n=2048, seed=2)
+    monkeypatch.setattr(dist_ctx.memory_pool, "comm_budget_bytes",
+                        lambda: 1024)
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    with telemetry.collect_phases() as cp:
+        txt = pipe.explain(analyze=True)
+    assert "[MEM]" in txt, txt
+    assert cp.count("plan.preflight") == 1
+    i = cp.labels.index("plan.preflight")
+    attrs = cp.spans[i].attrs
+    assert attrs["comm_budget_bytes"] == 1024
+    assert attrs["est_bytes"] > 1024
+    assert attrs["over_budget_nodes"] >= 1
+    rep = pipe.last_report
+    assert rep.budget == 1024
+    assert rep.root.mem_warn is True
+    assert rep.to_dict()["comm_budget_bytes"] == 1024
+
+
+def test_no_mem_marker_without_budget(dist_ctx):
+    """The CPU mesh has no comm budget (available_bytes None): no [MEM]
+    markers, no preflight span — the default path stays quiet."""
+    left, right = _table(dist_ctx, seed=1), _table(dist_ctx, seed=2)
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    with telemetry.collect_phases() as cp:
+        txt = pipe.explain(analyze=True)
+    assert "[MEM]" not in txt
+    assert cp.count("plan.preflight") == 0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_ring_records_completed_root_spans(local_ctx):
+    with telemetry.span("flight.ring.probe"):
+        with telemetry.span("child"):
+            pass
+    recent = flight.recent()
+    assert recent and recent[-1].name == "flight.ring.probe"
+    assert [c.name for c in recent[-1].children] == ["child"]
+
+
+def test_crash_dump_written_on_root_error(dist_ctx, tmp_path,
+                                          monkeypatch):
+    """An exception crossing a root span writes one parseable JSON dump
+    with the in-flight span stack, metrics, nonzero (ledger-backed)
+    pool watermarks and the outstanding set."""
+    monkeypatch.setenv("CYLON_FLIGHT_DIR", str(tmp_path))
+    t = _table(dist_ctx, n=1024, seed=9)
+    ledger.track(t, "crash_test_live")
+    with pytest.raises(RuntimeError, match="synthetic"):
+        with telemetry.span("plan.query"):
+            with telemetry.span("plan.shuffle.explicit", world=4):
+                raise RuntimeError("synthetic collective failure")
+    dumps = glob.glob(str(tmp_path / "*.json"))
+    assert len(dumps) == 1, dumps
+    doc = json.load(open(dumps[0]))
+    assert doc["kind"] == "cylon-flight-crash-dump"
+    assert [s["name"] for s in doc["error_path"]] == \
+        ["plan.query", "plan.shuffle.explicit"]
+    assert all(s["error"] for s in doc["error_path"])
+    assert doc["pool"]["bytes_in_use"] > 0
+    assert doc["pool"]["peak_bytes"] >= doc["pool"]["bytes_in_use"]
+    assert any(e["owner"] == "crash_test_live"
+               for e in doc["ledger_outstanding"])
+    assert isinstance(doc["metrics"], dict) and doc["metrics"]
+    assert any(k.startswith("cylon_phase_latency_ms")
+               for k in doc["metrics"])
+    assert doc["environment"]["env"].get("CYLON_FLIGHT_DIR") == \
+        str(tmp_path)
+    assert flight.last_dump_path() == dumps[0]
+    t.clear()
+
+
+def test_no_dump_without_flight_dir(local_ctx, tmp_path, monkeypatch):
+    monkeypatch.delenv("CYLON_FLIGHT_DIR", raising=False)
+    with pytest.raises(ValueError):
+        with telemetry.span("undumped.root"):
+            raise ValueError("x")
+    # ring still recorded it; no file anywhere to check — the recorder
+    # must simply not have crashed the unwinding
+    assert flight.recent()[-1].name == "undumped.root"
+    assert flight.recent()[-1].error is True
+
+
+def test_error_path_picks_deepest_errored_chain():
+    root = telemetry.Span("root", span_id=1, error=True)
+    ok_child = telemetry.Span("ok", span_id=2)
+    bad_child = telemetry.Span("bad", span_id=3, error=True)
+    bad_leaf = telemetry.Span("bad.leaf", span_id=4, error=True)
+    bad_child.children.append(bad_leaf)
+    root.children.extend([ok_child, bad_child])
+    assert [s.name for s in flight.error_path(root)] == \
+        ["root", "bad", "bad.leaf"]

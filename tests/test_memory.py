@@ -104,6 +104,65 @@ def test_gauge_sampling_real_ctx(local_ctx):
         assert "cylon_comm_budget_bytes" not in snap
 
 
+def test_snapshot_aggregates_in_one_call():
+    """snapshot() returns (bytes_in_use, peak, limit) with ONE
+    memory_stats call per device (the old trio paid three)."""
+
+    class _CountingDev(_StatsDev):
+        calls = 0
+
+        def memory_stats(self):
+            _CountingDev.calls += 1
+            return self._stats
+
+    pool = MemoryPool([_CountingDev(1000, 300, 500),
+                       _CountingDev(1000, 100, 200)])
+    _CountingDev.calls = 0   # constructor probes don't count
+    assert pool.snapshot() == (400, 700, 2000)
+    assert _CountingDev.calls == 2
+
+
+def test_snapshot_hidden_backend_monotonic_peak_via_external():
+    """The fallback (CYLON_HBM_BYTES) path: live bytes come from the
+    external (ledger) source and peak is the pool's monotonic
+    high-water mark — previously both read 0 on axon/tunneled
+    backends, silently blanking span hbm_peak attrs."""
+    pool = MemoryPool([_HiddenDev("axon")])
+    live = {"v": 0}
+    pool.set_external_source(lambda: live["v"])
+    assert pool.snapshot() == (0, 0, DEFAULT_TPU_HBM_BYTES)
+    live["v"] = 500
+    assert pool.snapshot()[:2] == (500, 500)
+    live["v"] = 100
+    used, peak, limit = pool.snapshot()
+    assert (used, peak) == (100, 500)   # peak is monotonic
+    assert limit == DEFAULT_TPU_HBM_BYTES
+    # the method trio reads the same ledger-backed numbers
+    assert pool.bytes_allocated() == 100
+    assert pool.peak_bytes() == 500
+
+
+def test_snapshot_cpu_hidden_backend_external_source():
+    """Even off-TPU (no CYLON_HBM_BYTES fallback limit), a hidden-stats
+    backend self-accounts through the external source — the CPU test
+    mesh's crash dumps carry real watermarks."""
+    pool = MemoryPool([_HiddenDev("cpu")])
+    pool.set_external_source(lambda: 42)
+    assert pool.snapshot() == (42, 42, 0)
+    # headroom stays unknowable (None), as before
+    assert pool.available_bytes() is None
+
+
+def test_snapshot_external_source_errors_read_as_zero():
+    pool = MemoryPool([_HiddenDev("axon")])
+
+    def explode():
+        raise RuntimeError("ledger gone")
+
+    pool.set_external_source(explode)
+    assert pool.snapshot()[0] == 0
+
+
 def test_pool_prefers_stats_over_fallback(monkeypatch):
     """A mesh mixing stats-backed and hidden devices uses the real
     stats (the fallback only arms when NO device reports)."""
