@@ -29,16 +29,22 @@ from .parallel.dist_ops import (distributed_groupby, distributed_join,
 from .parallel.shard import distribute_by_key
 from . import plan
 from .plan import LazyTable, col
-from .status import Code, CylonError, Status
+from . import resilience
+from .status import (Code, CylonDataError, CylonError, CylonPlanError,
+                     CylonResourceExhausted, CylonTimeoutError,
+                     CylonTransientError, Status)
 
 __version__ = "0.1.0"
 
 __all__ = [
     "AggregationOp", "Code", "Column", "CommConfig", "CommType",
-    "CSVReadOptions", "CSVWriteOptions", "CylonContext", "CylonError",
+    "CSVReadOptions", "CSVWriteOptions", "CylonContext",
+    "CylonDataError", "CylonError", "CylonPlanError",
+    "CylonResourceExhausted", "CylonTimeoutError",
+    "CylonTransientError",
     "DataType", "JoinAlgorithm", "JoinConfig", "JoinType", "Layout",
     "LazyTable", "LocalConfig", "MPIConfig", "MultiHostConfig",
-    "ParquetOptions", "Row", "col", "plan",
+    "ParquetOptions", "Row", "col", "plan", "resilience",
     "Status", "TPUConfig", "Table", "Type", "concat_tables",
     "distribute_by_key", "distributed_groupby", "distributed_join",
     "distributed_join_ring", "distributed_set_op",

@@ -25,7 +25,13 @@ registered in `core.CHECKERS` and runnable from one entry point:
                       ``distributed_*`` op and executor lowering must
                       register its output with the telemetry ledger,
                       or its HBM is unattributable to gauges, leak
-                      reports and crash dumps.
+                      reports and crash dumps;
+* ``errors``        — no silent swallowing: bare ``except:`` and
+                      broad ``except Exception`` handlers that
+                      neither re-raise nor report (log call /
+                      ``error=True`` span attr) are findings — a
+                      fault dying in one never reaches the
+                      resilience layer's retry or flight recorder.
 
 Run ``python -m cylon_tpu.analysis`` (see ``--help``); wired into
 ``scripts/check.sh`` ahead of tier-1. Rule catalog, suppression syntax
@@ -43,6 +49,7 @@ from . import collectives as _collectives    # noqa: F401,E402
 from . import witness as _witness            # noqa: F401,E402
 from . import spancov as _spancov            # noqa: F401,E402
 from . import ledgercov as _ledgercov        # noqa: F401,E402
+from . import errors as _errors              # noqa: F401,E402
 
 __all__ = ["AnalysisContext", "CHECKERS", "Finding", "RunResult",
            "SCHEMA_VERSION", "register", "run_checkers", "to_json_text"]

@@ -82,7 +82,7 @@ def main(argv=None) -> int:
         # (collectives/witness) are about the REAL package's kernels
         # and optimizer — run only the file-scanning families
         families = ["layering", "hostsync", "span-coverage",
-                    "ledger-coverage"]
+                    "ledger-coverage", "errors"]
 
     ctx = AnalysisContext(root, options)
     try:

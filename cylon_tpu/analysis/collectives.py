@@ -420,7 +420,7 @@ def check_collectives(ctx: AnalysisContext) -> List[Finding]:
             try:
                 fn = e.build(mesh)
                 closed = jax.make_jaxpr(fn)(*e.inputs(mesh))
-            except Exception as exc:  # noqa: BLE001 - reported as finding
+            except Exception as exc:  # noqa: BLE001 - reported as finding  # cylint: disable=errors/broad-swallow — trace failure becomes a Finding below
                 findings.append(Finding(
                     rule="collectives/trace-error", path=e.path,
                     line=line,

@@ -113,6 +113,18 @@ DEFAULT_CONTRACTS: Tuple[LayerContract, ...] = (
                "tests compare",
     ),
     LayerContract(
+        name="resilience-below-exec",
+        scope=("resilience",),
+        forbid=("",),                 # any intra-package import...
+        allow=("resilience", "status", "telemetry"),
+        # ...except its own submodules, the error taxonomy and the
+        # telemetry leaf it records into
+        reason="the resilience layer (inject/retry/admission) sits "
+               "between the base leaves and the execution layers: "
+               "parallel/, plan/ and io/ call INTO it — an import of "
+               "the machinery it wraps would cycle the retry seam",
+    ),
+    LayerContract(
         name="analysis-read-only",
         scope=("analysis",),
         forbid=("data", "io", "table_api", "arrow_builder"),

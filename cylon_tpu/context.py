@@ -39,7 +39,7 @@ def _distributed_initialized() -> bool:
         from jax._src import distributed as _jd
 
         return getattr(_jd.global_state, "client", None) is not None
-    except Exception:  # pragma: no cover - jax internals moved
+    except Exception:  # pragma: no cover - jax internals moved  # cylint: disable=errors/broad-swallow — jax internals moved: treat as uninitialized
         return False
 
 

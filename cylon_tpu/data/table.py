@@ -290,7 +290,14 @@ class Table:
     def clear(self) -> None:
         # free event: retire this table's ledger entry (if any) so
         # cylon_live_table_bytes drops and leak reports stay honest —
-        # _free_if_unretained and finalize both route through here
+        # _free_if_unretained and finalize both route through here.
+        # IDEMPOTENT under double-release: resilience retry/degrade
+        # paths can re-enter cleanup (an op frees its non-retained
+        # inputs, then the caller's error path finalizes again) — the
+        # second call must be a no-op, never a second ledger event
+        if getattr(self, "_cleared", False):
+            return
+        self._cleared = True
         _telemetry.ledger.release(self)
         self._columns = []
         self.row_mask = None

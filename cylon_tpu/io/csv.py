@@ -15,7 +15,9 @@ from typing import List, Optional, Sequence, Union
 from ..config import CSVReadOptions, CSVWriteOptions
 from ..context import CylonContext
 from ..data.table import Table, concat_tables
-from ..status import Code, CylonError
+from ..resilience import inject as _inject
+from ..resilience import retry as _retry
+from ..status import Code, CylonDataError, CylonError
 
 
 def _arrow_options(options: CSVReadOptions):
@@ -102,16 +104,33 @@ def read_csv_per_rank(ctx: CylonContext, path_pattern: str,
 
 
 def _read_one(ctx: CylonContext, path: str, options: CSVReadOptions) -> Table:
+    import pyarrow as pa
     import pyarrow.csv as pacsv
 
     read_opts, parse_opts, convert_opts = _arrow_options(options)
-    try:
-        pa_table = pacsv.read_csv(path, read_options=read_opts,
+
+    def attempt():
+        _inject.fire("ingest", detail=f"csv {path}")
+        try:
+            return pacsv.read_csv(path, read_options=read_opts,
                                   parse_options=parse_opts,
                                   convert_options=convert_opts)
-    except FileNotFoundError as e:
-        raise CylonError(Code.IOError, str(e))
-    return Table.from_arrow(ctx, pa_table)
+        except OSError as e:
+            # environment errors (missing file, permissions, disk) are
+            # IOError — fixable without touching the bytes, NOT bad
+            # data
+            raise CylonError(Code.IOError, str(e))
+        except (pa.ArrowInvalid, pa.ArrowException, ValueError) as e:
+            # malformed bytes are a DATA error, typed and
+            # non-retryable — the parser's traceback never reaches
+            # the caller
+            raise CylonDataError(f"malformed CSV {path}: {e}") from e
+
+    # transient filesystem failures retry under the same bounded
+    # policy as exchanges; IOError/DataError are non-retryable and
+    # leave the loop on the first attempt
+    return Table.from_arrow(ctx, _retry.run_retryable("ingest",
+                                                      attempt))
 
 
 def write_csv(table: Table, path: str,

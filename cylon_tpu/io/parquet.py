@@ -7,20 +7,41 @@ from typing import Optional, Sequence, Union
 from ..config import ParquetOptions
 from ..context import CylonContext
 from ..data.table import Table, concat_tables
-from ..status import Code, CylonError
+from ..resilience import inject as _inject
+from ..resilience import retry as _retry
+from ..status import Code, CylonDataError, CylonError
+
+
+def _read_table(path: str):
+    """One parquet file -> pyarrow table, with the error taxonomy
+    applied: missing file / permissions = IOError, malformed bytes
+    (truncated footer, bad magic, garbage) = typed
+    :class:`CylonDataError` — never a raw backend traceback. Transient
+    filesystem failures retry under the bounded policy."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    def attempt():
+        _inject.fire("ingest", detail=f"parquet {path}")
+        try:
+            return pq.read_table(path)
+        except OSError as e:
+            # environment errors (missing file, permissions, disk)
+            # are IOError — fixable without touching the bytes, NOT
+            # bad data
+            raise CylonError(Code.IOError, str(e))
+        except (pa.ArrowInvalid, pa.ArrowException, ValueError) as e:
+            raise CylonDataError(
+                f"malformed parquet {path}: {e}") from e
+
+    return _retry.run_retryable("ingest", attempt)
 
 
 def read_parquet(ctx: CylonContext, path: Union[str, Sequence[str]],
                  options: Optional[ParquetOptions] = None) -> Table:
-    import pyarrow.parquet as pq
-
     if isinstance(path, (list, tuple)):
         return concat_tables([read_parquet(ctx, p, options) for p in path], ctx)
-    try:
-        pa_table = pq.read_table(path)
-    except FileNotFoundError as e:
-        raise CylonError(Code.IOError, str(e))
-    return Table.from_arrow(ctx, pa_table)
+    return Table.from_arrow(ctx, _read_table(path))
 
 
 def read_parquet_per_rank(ctx: CylonContext, path_pattern: str,
@@ -32,17 +53,12 @@ def read_parquet_per_rank(ctx: CylonContext, path_pattern: str,
     cpp/test/join_test.cpp:22-24). Multi-host: each controller process
     reads only the shards it owns; collective, all processes must call
     it."""
-    import pyarrow.parquet as pq
-
     from ..parallel import shard as _shard
 
     tables = []
     for i in ctx.local_shard_indices():
         p = path_pattern.format(rank=i)
-        try:
-            tables.append(Table.from_arrow(ctx, pq.read_table(p)))
-        except FileNotFoundError as e:
-            raise CylonError(Code.IOError, str(e))
+        tables.append(Table.from_arrow(ctx, _read_table(p)))
     return _shard.assemble_process_local(tables, ctx)
 
 

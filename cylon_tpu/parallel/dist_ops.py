@@ -45,7 +45,7 @@ from ..ops import hash as _hash
 from ..ops import join as _join
 from ..ops import order as _order
 from ..ops import setops as _setops
-from ..status import Code, CylonError
+from ..status import Code, CylonPlanError
 from ..telemetry import annotate as _annotate, counted_cache, \
     ledger as _ledger, phase as _phase, record_host_sync as _host_sync, \
     span as _span
@@ -501,8 +501,9 @@ def _align_key_columns_dist(ctx: CylonContext, left_d: Table,
     for li, ri in zip(lidx, ridx):
         a, b = left_d._columns[li], right_d._columns[ri]
         if a.is_string != b.is_string:
-            raise CylonError(Code.TypeError,
-                             f"join key type mismatch: {a.name} vs {b.name}")
+            raise CylonPlanError(
+                f"join key type mismatch: {a.name} vs {b.name}",
+                code=Code.TypeError)
         if a.is_string:
             if a.is_varbytes or b.is_varbytes:
                 a = _dist_as_varbytes(ctx, a)
@@ -1455,7 +1456,7 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp,
         return _ledger.track(table_mod.set_op(left, right, op),
                              "distributed_set_op")
     if left.column_count != right.column_count:
-        raise CylonError(Code.Invalid, "set ops need equal schemas")
+        raise CylonPlanError("set ops need equal schemas")
 
     left_d = shard.distribute(left, ctx)
     right_d = shard.distribute(right, ctx)
@@ -1657,8 +1658,9 @@ def distributed_groupby(table: Table, index_col, aggregate_cols: List,
     for vi, op in zip(val_cols, aggregate_ops):
         if t._columns[vi].is_varbytes and \
                 op != _groupby.AggregationOp.COUNT:
-            raise CylonError(Code.NotImplemented,
-                             "varbytes value columns support COUNT only")
+            raise CylonPlanError(
+                "varbytes value columns support COUNT only",
+                code=Code.NotImplemented)
 
     seq = ctx.get_next_sequence()
     ops = list(aggregate_ops)

@@ -26,7 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..context import CylonContext
 from ..data.column import Column
 from ..data.table import Table
-from ..status import Code, CylonError
+from ..status import Code, CylonPlanError
 from ..telemetry import record_host_sync as _host_sync
 
 # Per-shard capacities are rounded to a multiple of 8 (TPU sublane quantum)
@@ -269,10 +269,10 @@ def distribute_by_key(table: Table, ctx: CylonContext, key_columns) -> Table:
         from ..data.strings import VarBytes
 
         if ctx.get_process_count() > 1:
-            raise CylonError(
-                Code.NotImplemented,
+            raise CylonPlanError(
                 "multi-host distribute_by_key with varbytes columns: "
-                "use per-rank file placement (read_csv_per_rank)")
+                "use per-rank file placement (read_csv_per_rank)",
+                code=Code.NotImplemented)
 
         shard_tables = []
         for s in range(world):
@@ -330,9 +330,9 @@ def assemble_process_local(tables, ctx: CylonContext) -> Table:
 
     local = ctx.local_shard_indices()
     if len(tables) != len(local):
-        raise CylonError(
-            Code.Invalid,
-            f"need one table per local shard ({len(local)}), got {len(tables)}")
+        raise CylonPlanError(
+            f"need one table per local shard ({len(local)}), "
+            f"got {len(tables)}")
     tables = [t.compact() for t in tables]
 
     first = tables[0]

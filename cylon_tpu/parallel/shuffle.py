@@ -47,6 +47,8 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from ..context import CylonContext
+from ..resilience import inject as _inject
+from ..resilience import retry as _retry
 from ..telemetry import counted_cache, counter as _counter, \
     phase as _phase, record_host_sync as _host_sync, span as _span
 from ..telemetry import skew as _skew
@@ -91,6 +93,23 @@ def _record_exchange(rows: int, nbytes: int, programs: int = 1) -> None:
     _counter("cylon_shuffle_bytes_total").inc(nbytes)
     _counter("cylon_rows_exchanged_total").inc(rows)
     _counter("cylon_collective_launches_total").inc(programs)
+
+
+def _launch_exchange(fn):
+    """One exchange program dispatch under the resilience policy: the
+    chaos injector's ``exchange`` choke point fires first (so every
+    retry attempt is one arrival — a persistent fault plan keeps
+    failing), then the dispatch runs under bounded retry-with-backoff.
+    Re-dispatching is safe: the compiled program is a pure function of
+    its device inputs, and a faulted kernel-factory build is not
+    cached, so retries rebuild it. Runs INSIDE the exchange span, so a
+    recovered stage carries the ``retries`` attr EXPLAIN ANALYZE
+    renders as ``[RETRY×n]``."""
+    def attempt():
+        _inject.fire("exchange")
+        return fn()
+
+    return _retry.run_retryable("exchange", attempt)
 
 
 def _payload_row_bytes(payload) -> int:
@@ -321,8 +340,10 @@ def exchange_pair(payload1, targets1, emit1, counts1,
             nbytes = _payload_nbytes(payload1) + _payload_nbytes(payload2)
             with _span("shuffle.exchange_pair", seq, world=1,
                        mode="padded", rows=rows, bytes_moved=nbytes):
-                res = _exchange_padded_pair_fn(ctx.mesh, b1, b2)(
-                    payload1, targets1, emit1, payload2, targets2, emit2)
+                res = _launch_exchange(
+                    lambda: _exchange_padded_pair_fn(ctx.mesh, b1, b2)(
+                        payload1, targets1, emit1, payload2, targets2,
+                        emit2))
             _record_exchange(rows, nbytes)
             out1, emit1_o, ci1, out2, emit2_o, ci2 = res
             return ((out1, emit1_o, b1,
@@ -353,8 +374,10 @@ def exchange_pair(payload1, targets1, emit1, counts1,
                    mode="padded", rows=rows, bytes_moved=nbytes) as sp:
             if pair_stats is not None:
                 sp.set(**pair_stats.span_attrs())
-            res = _exchange_padded_pair_fn(ctx.mesh, b1, b2)(
-                payload1, targets1, emit1, payload2, targets2, emit2)
+            res = _launch_exchange(
+                lambda: _exchange_padded_pair_fn(ctx.mesh, b1, b2)(
+                    payload1, targets1, emit1, payload2, targets2,
+                    emit2))
         _record_exchange(rows, nbytes)
         out1, emit1_o, ci1, out2, emit2_o, ci2 = res
         return ((out1, emit1_o, world * b1,
@@ -492,10 +515,14 @@ def count_pair(targets1, emit1, targets2, emit2, ctx: CylonContext):
         _counter("cylon_collective_launches_total").inc()
         return both[:, 0, :], both[:, 1, :]
 
+    # the count program is part of the exchange stage: transient
+    # failures (and injected compile faults in its factory build)
+    # retry under the same policy as the body dispatch
     return _count_cached(
         ("pair", id(ctx.mesh), id(targets1), id(emit1), id(targets2),
          id(emit2)),
-        (targets1, emit1, targets2, emit2), compute)
+        (targets1, emit1, targets2, emit2),
+        lambda: _retry.run_retryable("exchange.count", compute))
 
 
 def _budget_block_cap(payload, world: int, budget, mb: int,
@@ -576,8 +603,9 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
             nbytes = _payload_nbytes(payload)
             with _span("shuffle.exchange", seq, world=1, mode="padded",
                        rows=rows, bytes_moved=nbytes):
-                out, new_emit, counts_in = _exchange_padded_fn(
-                    ctx.mesh, block1)(payload, targets, emit)
+                out, new_emit, counts_in = _launch_exchange(
+                    lambda: _exchange_padded_fn(
+                        ctx.mesh, block1)(payload, targets, emit))
             _record_exchange(rows, nbytes)
             return out, new_emit, block1, {
                 "mode": "padded", "block": block1, "counts_in": counts_in}
@@ -592,7 +620,8 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
 
         counts = _count_cached(
             ("one", id(ctx.mesh), id(targets), id(emit)),
-            (targets, emit), compute)
+            (targets, emit),
+            lambda: _retry.run_retryable("exchange.count", compute))
     max_pair = int(counts.max()) if counts.size else 0
     recv_max = int(counts.sum(axis=0).max()) if counts.size else 0
     budget = ctx.memory_pool.comm_budget_bytes()
@@ -612,8 +641,9 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
         if skew_stats is not None:
             sp.set(**skew_stats.span_attrs())
         if padded_ok:
-            out, new_emit, counts_in = _exchange_padded_fn(
-                ctx.mesh, block_p)(payload, targets, emit)
+            out, new_emit, counts_in = _launch_exchange(
+                lambda: _exchange_padded_fn(
+                    ctx.mesh, block_p)(payload, targets, emit))
             _record_exchange(rows_live, nbytes)
             return out, new_emit, cap_padded, {
                 "mode": "padded", "block": block_p, "counts_in": counts_in}
@@ -621,8 +651,10 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
         # pow2 round count bounds the compile cache to O(log^3) programs
         rounds = _pow2(-(-max(max_pair, 1) // block))
         sp.set(block=block, rounds=rounds)
-        out, new_emit, counts_in = _exchange_fn(
-            ctx.mesh, block, rounds, cap_compact)(payload, targets, emit)
+        out, new_emit, counts_in = _launch_exchange(
+            lambda: _exchange_fn(
+                ctx.mesh, block, rounds, cap_compact)(payload, targets,
+                                                      emit))
     _record_exchange(rows_live, nbytes)
     return out, new_emit, cap_compact, {
         "mode": "compact", "block": 0, "counts_in": counts_in}

@@ -47,7 +47,9 @@ from typing import Optional, Tuple
 from .. import table_api, telemetry
 from ..data import table as table_mod
 from ..data.table import Table
-from ..status import Code, CylonError
+from ..resilience import admission as _admission
+from ..resilience import retry as _resil
+from ..status import Code, CylonPlanError
 from ..telemetry import ledger as _ledger, span as _span
 from . import ir
 
@@ -78,7 +80,10 @@ def _preflight(plan: ir.PlanNode, ctx):
 
     est = preflight_estimates(plan)
     pool = getattr(ctx, "memory_pool", None) if ctx is not None else None
-    budget = pool.comm_budget_bytes() if pool is not None else None
+    # effective budget = pool comm budget clamped by an armed chaos
+    # `pool` fault spec — the [MEM] markers, the warning span AND the
+    # admission controller all see the same number
+    budget = _admission.effective_budget(pool)
     if not budget:
         return est, budget
     over = [n for n in ir.walk(plan)
@@ -99,12 +104,39 @@ def _preflight(plan: ir.PlanNode, ctx):
     return est, budget
 
 
+def _admit(plan: ir.PlanNode, ctx, est, budget):
+    """Run the admission controller over the pre-flight estimates:
+    records the decision (counter + log + flight admission ring) and
+    ENFORCES a shed — an over-budget query raises
+    :class:`CylonResourceExhausted` here, before any device work. A
+    degrade decision returns the per-join ``probe_block_rows`` map the
+    executor lowers with."""
+    world = _world(ctx) if ctx is not None else 1
+    decision = _admission.decide(list(ir.walk(plan)), est, budget,
+                                 world)
+    _admission.record(decision)
+    if decision.action != "admit":
+        with _span("plan.admission", decision=decision.action,
+                   est_bytes=decision.est_bytes,
+                   budget=decision.budget,
+                   worst_node=decision.worst_node or ""):
+            pass
+    _admission.enforce(decision)
+    return decision
+
+
 def execute(plan: ir.PlanNode, ctx=None) -> Table:
     """Execute a plan; returns the result Table (sharded when the
     context is distributed). ``ctx`` defaults to the first scanned
-    table's context."""
-    _preflight(plan, _resolve_ctx(plan, ctx))
-    return _Exec(ctx).run(plan)
+    table's context. Runs under the per-query deadline
+    (``CYLON_QUERY_DEADLINE_S``) and the admission controller — a shed
+    query raises :class:`CylonResourceExhausted` before any device
+    work."""
+    rctx = _resolve_ctx(plan, ctx)
+    with _resil.query_deadline():
+        est, budget = _preflight(plan, rctx)
+        decision = _admit(plan, rctx, est, budget)
+        return _Exec(ctx, degrade=decision.degrade_blocks).run(plan)
 
 
 def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None
@@ -116,14 +148,20 @@ def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None
     after the run, the registry snapshot rides along so a BENCH
     artifact is one ``report.to_dict()`` away, and the ledger's
     end-of-query leak report (allocated under this root span, never
-    freed, query result excluded) lands on ``report.leaks``."""
+    freed, query result excluded) lands on ``report.leaks``. Deadline
+    expiry and admission sheds raise INSIDE the ``plan.query`` span,
+    so the flight recorder dumps the full forensic state."""
     from .report import PlanReport, build_measures
 
+    rctx = _resolve_ctx(plan, ctx)
     with telemetry.collect_phases() as cp:
         with _span("plan.query") as root_span:
-            est, budget = _preflight(plan, _resolve_ctx(plan, ctx))
-            ex = _Exec(ctx, recorder=_Recorder(cp.labels))
-            result = ex.run(plan)
+            with _resil.query_deadline():
+                est, budget = _preflight(plan, rctx)
+                decision = _admit(plan, rctx, est, budget)
+                ex = _Exec(ctx, recorder=_Recorder(cp.labels),
+                           degrade=decision.degrade_blocks)
+                result = ex.run(plan)
     leaks = _ledger.leak_report(root_span.span_id,
                                 exclude={id(result)})
     pool = getattr(ex.ctx, "memory_pool", None) if ex.ctx is not None \
@@ -138,7 +176,8 @@ def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None
         world=_world(ex.ctx) if ex.ctx is not None else 1,
         stats=stats, memory=memory,
         metrics=telemetry.metrics_snapshot(),
-        leaks=leaks, budget=budget)
+        leaks=leaks, budget=budget,
+        admission=decision.to_dict())
     return result, report
 
 
@@ -169,15 +208,23 @@ class _Recorder:
 
 
 class _Exec:
-    def __init__(self, ctx=None, recorder: Optional[_Recorder] = None):
+    def __init__(self, ctx=None, recorder: Optional[_Recorder] = None,
+                 degrade: Optional[dict] = None):
         self.ctx = ctx
         self._recorder = recorder
+        # id(Join node) -> probe_block_rows, from the admission
+        # controller's degrade decision (blocked/chunked lowering)
+        self._degrade = degrade or {}
 
     def run(self, node: ir.PlanNode) -> Table:
+        # node boundaries are the deadline check points: a query past
+        # its budget stops before dispatching the next stage
+        _resil.check_deadline(f"plan.{node.kind}")
         fn = getattr(self, f"_do_{node.kind}", None)
         if fn is None:
-            raise CylonError(Code.NotImplemented,
-                             f"no lowering for {type(node).__name__}")
+            raise CylonPlanError(
+                f"no lowering for {type(node).__name__}",
+                code=Code.NotImplemented)
         if self._recorder is None:
             return fn(node)
         return self._recorder.run(node, fn)
@@ -277,9 +324,23 @@ class _Exec:
                 + int(self._side_exchanges(rt, node.right_on, lt,
                                            node.left_on))
         label = "plan.shuffle.join" if n_ex else "plan.join"
+        blk = self._degrade.get(id(node))
         with _span(label, self._seq(), world=world, how=node.how,
                    sides_exchanged=n_ex,
-                   rows_in=lt.capacity + rt.capacity):
+                   rows_in=lt.capacity + rt.capacity) as sp:
+            if blk:
+                # admission-controller degrade: the blocked/chunked
+                # local join bounds the working set to build side + one
+                # probe block (decided only on world==1 plans, where
+                # distributed_join short-circuits to the local join
+                # anyway — this is that path with an explicit block)
+                sp.set(mode="blocked", probe_block_rows=int(blk))
+                return _ledger.track(
+                    lt.join(rt, node.how, node.algorithm,
+                            left_on=list(node.left_on),
+                            right_on=list(node.right_on),
+                            probe_block_rows=int(blk)),
+                    "plan.join")
             return _ledger.track(
                 lt.distributed_join(
                     rt, node.how, node.algorithm,

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple, Union
 
-from ..status import Code, CylonError
+from ..status import Code, CylonPlanError
 
 # string-typed columns can never carry a hash-placement witness
 # (partition_signature returns None for them: vocabulary unification /
@@ -69,9 +69,9 @@ class Col:
 
     def _cmp(self, op, value):
         if isinstance(value, Col) or isinstance(value, Expr):
-            raise CylonError(Code.NotImplemented,
-                             "column-vs-column predicates: compare "
-                             "against literals")
+            raise CylonPlanError(
+                "column-vs-column predicates: compare against "
+                "literals", code=Code.NotImplemented)
         return Cmp(self.ref, op, value)
 
     def __eq__(self, v):  # type: ignore[override]
@@ -330,7 +330,7 @@ class SetOp(PlanNode):
 
     def __init__(self, left: PlanNode, right: PlanNode, op: str):
         if left.width != right.width:
-            raise CylonError(Code.Invalid, "set ops need equal schemas")
+            raise CylonPlanError("set ops need equal schemas")
         super().__init__([left, right], left.schema, left.types)
         self.op = str(op)
 

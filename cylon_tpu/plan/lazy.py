@@ -21,7 +21,7 @@ from typing import List, Optional, Sequence, Union
 
 from .. import table_api
 from ..data.table import Table
-from ..status import Code, CylonError
+from ..status import Code, CylonPlanError
 from . import ir
 from .executor import execute as _execute, \
     execute_analyzed as _execute_analyzed
@@ -74,10 +74,12 @@ class LazyTable:
             try:
                 return self._node.schema.index(c)
             except ValueError:
-                raise CylonError(Code.KeyError, f"no column named {c!r}")
+                raise CylonPlanError(f"no column named {c!r}",
+                                     code=Code.KeyError)
         i = int(c)
         if not (0 <= i < self._node.width):
-            raise CylonError(Code.KeyError, f"column {i} out of range")
+            raise CylonPlanError(f"column {i} out of range",
+                                 code=Code.KeyError)
         return i
 
     def _positions(self, cols) -> List[int]:
@@ -99,8 +101,8 @@ class LazyTable:
 
     def filter(self, expr) -> "LazyTable":
         if isinstance(expr, ir.Col):
-            raise CylonError(Code.Invalid,
-                             "filter needs a predicate, e.g. col('x') > 3")
+            raise CylonPlanError(
+                "filter needs a predicate, e.g. col('x') > 3")
         bound = expr.bind(self._pos)
         return self._wrap(ir.Filter(self._node, bound))
 
@@ -111,8 +113,8 @@ class LazyTable:
              algorithm: str = "auto", on=None, left_on=None,
              right_on=None) -> "LazyTable":
         if join_type not in _JOIN_TYPES:
-            raise CylonError(Code.Invalid,
-                             f"unsupported join type {join_type!r}")
+            raise CylonPlanError(
+                f"unsupported join type {join_type!r}")
         if on is not None:
             lidx = self._positions(on)
             ridx = other._positions(on)
@@ -120,8 +122,8 @@ class LazyTable:
             lidx = self._positions(left_on)
             ridx = other._positions(right_on)
         else:
-            raise CylonError(Code.Invalid,
-                             "'on' or 'left_on'+'right_on' required")
+            raise CylonPlanError(
+                "'on' or 'left_on'+'right_on' required")
         return self._wrap(ir.Join(self._node, other._node, lidx, ridx,
                                   join_type, algorithm))
 
@@ -132,7 +134,7 @@ class LazyTable:
         ops = [str(o).lower() for o in aggregate_ops]
         for o in ops:
             if o not in _AGG_OPS:
-                raise CylonError(Code.Invalid, f"unknown aggregate {o!r}")
+                raise CylonPlanError(f"unknown aggregate {o!r}")
         return self._wrap(ir.GroupBy(self._node, keys, aggs, ops))
 
     def sort(self, by, ascending=True) -> "LazyTable":

@@ -96,7 +96,7 @@ def _scan_rows(node: "ir.Scan") -> Optional[int]:
             from .. import table_api
 
             t = table_api.get_table(node.table_id)
-        except Exception:
+        except Exception:  # cylint: disable=errors/broad-swallow — unregistered table: no row estimate
             return None
     return int(t.capacity) if t is not None else None
 
@@ -153,6 +153,8 @@ class NodeMeasure:
     skew: Optional[dict] = None    # worst own-exchange skew (see below)
     est_bytes: Optional[int] = None  # pre-flight output-size estimate
     mem_warn: bool = False         # est_bytes exceeded the comm budget
+    retries: int = 0               # retried stages under this node's
+    #                                own spans (resilience layer)
 
     @property
     def shuffles(self) -> int:
@@ -173,9 +175,10 @@ class NodeMeasure:
         est = f", est={_human_bytes(self.est_bytes)}" \
             if self.est_bytes is not None else ""
         mem = "  [MEM]" if self.mem_warn else ""
+        rt = f"  [RETRY×{self.retries}]" if self.retries else ""
         return (f"{self.desc}{pb}  (actual time={self.ms:.2f} ms, "
                 f"rows={self.rows}, bytes={_human_bytes(self.bytes)}"
-                f"{est}, shuffles={self.shuffles}{sk}){mem}")
+                f"{est}, shuffles={self.shuffles}{sk}){mem}{rt}")
 
     def to_dict(self) -> dict:
         return {
@@ -186,6 +189,7 @@ class NodeMeasure:
             "ms": round(self.ms, 3) if self.ms is not None else None,
             "rows": self.rows, "bytes": self.bytes,
             "est_bytes": self.est_bytes, "mem_warn": self.mem_warn,
+            "retries": self.retries,
             "shuffles": self.shuffles, "labels": list(self.labels),
             "skew": dict(self.skew) if self.skew is not None else None,
             "children": [c.to_dict() for c in self.children],
@@ -258,12 +262,18 @@ def build_measures(node: ir.PlanNode, recs: Dict[int, object],
     own_idx = [i for i in range(r.i0, r.i1) if not covered[i - r.i0]]
     own = [labels[i] for i in own_idx]
     skew = None
+    retries = 0
     if spans is not None:
         skew = _fold_skew(
             [spans[i] for i in own_idx
              if spans[i].name.startswith("shuffle.exchange")])
+        # retried stages annotate their enclosing span (resilience
+        # retry loop) — fold them so the node renders [RETRY×n]
+        retries = sum(int(spans[i].attrs.get("retries", 0))
+                      for i in own_idx)
     return NodeMeasure(executed=True, ms=r.ms, rows=r.rows,
-                       bytes=r.nbytes, labels=own, skew=skew, **base)
+                       bytes=r.nbytes, labels=own, skew=skew,
+                       retries=retries, **base)
 
 
 @dataclass
@@ -281,6 +291,7 @@ class PlanReport:
     metrics: dict = field(default_factory=dict)  # registry snapshot
     leaks: List[dict] = field(default_factory=list)  # ledger leak report
     budget: Optional[int] = None   # comm_budget_bytes at preflight
+    admission: Optional[dict] = None  # admission-controller decision
 
     def render(self) -> str:
         def fmt(m: NodeMeasure, indent: str = "") -> List[str]:
@@ -295,6 +306,11 @@ class PlanReport:
         lines.append(f"-- measured: {self.total_ms:.2f} ms total, "
                      f"{self.shuffle_count} exchange stage(s), "
                      f"world={self.world}")
+        if self.admission is not None and \
+                self.admission.get("action") != "admit":
+            lines.append(
+                f"-- admission: {self.admission['action']} "
+                f"({self.admission.get('reason', '')})")
         for leak in self.leaks:
             lines.append(
                 f"-- LEAK: {_human_bytes(leak['nbytes'])} "
@@ -312,6 +328,8 @@ class PlanReport:
         }
         if self.budget is not None:
             d["comm_budget_bytes"] = int(self.budget)
+        if self.admission is not None:
+            d["admission"] = dict(self.admission)
         if self.stats is not None:
             d["optimizer"] = {
                 "shuffles_inserted": self.stats.shuffles_inserted,

@@ -34,7 +34,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from ..status import Code, CylonError
+from ..status import Code, CylonPlanError
 from . import ir
 
 # (positions, dtypes) — both ordered, positions refer to the node's own
@@ -188,8 +188,7 @@ def check_plan(root: ir.PlanNode, world: int) -> None:
     post-assert)."""
     problems = verify_plan(root, world)
     if problems:
-        raise CylonError(
-            Code.ExecutionError,
+        raise CylonPlanError(
             "plan-witness verification failed:\n  "
             + "\n  ".join(problems) + "\n(plan)\n"
             + ir.format_plan(root))

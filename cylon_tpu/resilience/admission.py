@@ -1,0 +1,197 @@
+"""The admission controller: admit, degrade, or shed — before running.
+
+PR-5 built the raw material (planner pre-flight estimates + the
+ledger-backed ``live_bytes`` pool fallback) but only used it for a
+warning span; ROADMAP item 2 calls for turning it into a real
+controller with backpressure/shed paths. This module is that
+controller: the plan executor hands it the pre-flight estimate map and
+the pool, and gets back one of three decisions —
+
+* **admit**   — the worst node estimate fits the budget (or no budget
+  is knowable — stats-hidden backend with no ledger history): run
+  unchanged.
+* **degrade** — a Join's estimate exceeds the budget and the blocked/
+  chunked join path can bound the working set (ROADMAP item 4's
+  planner-visible blocked mode): the executor lowers the join with
+  ``probe_block_rows`` sized so one block's working set fits. Only
+  single-shard (world==1) joins degrade today — the distributed join's
+  exchange already bounds its comm buffers via the blockwise path, and
+  its post-exchange working set has no chunked lowering yet.
+* **shed**    — the estimate is beyond ``CYLON_SHED_FACTOR`` (default
+  8×) of the budget: raise :class:`CylonResourceExhausted` BEFORE
+  burning device time the query cannot finish with. Checked before
+  degrade — the blocked path bounds the join's WORKING SET, but the
+  estimate is the OUTPUT size, which degrade still materializes in
+  full. Over budget but under the factor with no degradable node
+  admits with the pre-flight warning.
+
+Budget: ``pool.comm_budget_bytes()`` (live-HBM aware — the pool's
+``available_bytes`` nets out ``live_bytes`` on stats-bearing backends
+and the ledger feeds it on hidden ones), clamped by the fault
+injector's ``pool`` site so chaos drills exercise both paths
+deterministically.
+
+Every decision is recorded: a ``cylon_admission_total{decision=}``
+counter, a log line, and an entry in the flight recorder's admission
+ring (``flight.admissions()``, included in crash dumps) — a shed query
+leaves the same forensic trail as a crashed one.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..status import CylonResourceExhausted
+from ..telemetry import flight as _flight
+from ..telemetry import logger as _logger
+from ..telemetry import metrics as _metrics
+from . import inject as _inject
+
+DEFAULT_SHED_FACTOR = 8.0
+
+# degraded joins never chunk below this many probe rows per block —
+# sub-1k blocks pay more per-dispatch overhead than they save memory
+MIN_BLOCK_ROWS = 1 << 10
+
+
+def shed_factor() -> float:
+    return _metrics.env_number("CYLON_SHED_FACTOR",
+                               DEFAULT_SHED_FACTOR, lo=1.0)
+
+
+def effective_budget(pool) -> Optional[int]:
+    """The byte budget admission decisions run against: the pool's comm
+    budget (duck-typed — admission never imports memory.py), clamped by
+    an armed ``pool`` fault spec. None = unknowable, admit."""
+    budget = None
+    if pool is not None:
+        try:
+            budget = pool.comm_budget_bytes()
+        except Exception:  # cylint: disable=errors/broad-swallow — a broken pool must not veto admission
+            budget = None
+    clamp = _inject.budget_clamp()
+    if clamp is not None:
+        budget = clamp if budget is None else min(budget, clamp)
+    return budget
+
+
+@dataclass
+class Decision:
+    """One admission decision over one plan."""
+
+    action: str                    # "admit" | "degrade" | "shed"
+    budget: Optional[int] = None
+    est_bytes: Optional[int] = None   # worst node estimate
+    worst_node: Optional[str] = None
+    reason: str = ""
+    # id(join node) -> probe_block_rows for degraded lowerings
+    degrade_blocks: Dict[int, int] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"action": self.action, "budget": self.budget,
+                "est_bytes": self.est_bytes,
+                "worst_node": self.worst_node, "reason": self.reason,
+                "degraded_nodes": len(self.degrade_blocks)}
+
+
+def _node_desc(node) -> str:
+    return f"{type(node).__name__}({node.args_repr()})"
+
+
+def decide(nodes: List[object], est: Dict[int, dict],
+           budget: Optional[int], world: int) -> Decision:
+    """The pure decision function: ``nodes`` is the plan's node list
+    (duck-typed — ``kind``/``args_repr``; admission never imports
+    plan/), ``est`` the pre-flight estimate map keyed by id(node).
+    Raises nothing; the executor enforces a shed decision."""
+    if not budget:
+        return Decision("admit", budget=budget,
+                        reason="no budget knowable")
+    # Scans are excluded: their bytes are ALREADY resident (borrowed
+    # user inputs) — admission controls the allocations a query is
+    # about to make, not history it cannot undo
+    over = [(n, est[id(n)]["bytes"]) for n in nodes
+            if n.kind != "scan"
+            and est.get(id(n), {}).get("bytes") is not None
+            and est[id(n)]["bytes"] > budget]
+    if not over:
+        # worst ALLOCATING estimate only — a huge borrowed Scan input
+        # must not make an admitted query's forensic record look like
+        # a waved-through 500x overrun
+        worst = max(
+            (est[id(n)]["bytes"] for n in nodes if n.kind != "scan"
+             if est.get(id(n), {}).get("bytes") is not None),
+            default=None)
+        return Decision("admit", budget=budget, est_bytes=worst,
+                        reason="within budget")
+    worst_node, worst_bytes = max(over, key=lambda p: p[1])
+    factor = worst_bytes / budget
+    if factor > shed_factor():
+        # beyond the shed factor NOTHING saves the query — the blocked
+        # path bounds the join's WORKING SET, but the estimate is the
+        # OUTPUT size, which degrade still materializes in full
+        return Decision(
+            "shed", budget=budget, est_bytes=worst_bytes,
+            worst_node=_node_desc(worst_node),
+            reason=f"estimate {factor:.1f}x over budget "
+                   f"(shed factor {shed_factor():.1f}, "
+                   f"world={world})")
+    # degrade: an over-budget JOIN can chunk its probe side so one
+    # block's working set fits. Only when EVERY over-budget node is a
+    # degradable join — degrading the join while a downstream node
+    # still blows the budget helps nothing.
+    over_joins = [(n, b) for n, b in over if n.kind == "join"]
+    degradable = world == 1 and over_joins \
+        and all(n.kind == "join" for n, _b in over)
+    if degradable:
+        blocks: Dict[int, int] = {}
+        for n, b in over_joins:
+            rows = est[id(n)].get("rows") or 0
+            if rows <= 0:
+                continue
+            blocks[id(n)] = max(int(rows * budget / b),
+                                MIN_BLOCK_ROWS)
+        if blocks:
+            return Decision(
+                "degrade", budget=budget, est_bytes=worst_bytes,
+                worst_node=_node_desc(worst_node),
+                degrade_blocks=blocks,
+                reason=f"{len(blocks)} join(s) over budget -> "
+                       f"blocked/chunked probe")
+    # moderately over budget with no chunked lowering available: admit
+    # — the exchange bounds its own comm buffers against this budget,
+    # and the pre-flight warning span already flags the risk
+    return Decision("admit", budget=budget, est_bytes=worst_bytes,
+                    worst_node=_node_desc(worst_node),
+                    reason=f"estimate {factor:.1f}x over budget, "
+                           f"under shed factor — admitted with "
+                           f"warning")
+
+
+def record(decision: Decision) -> Decision:
+    """Publish one decision (counter + log + flight admission ring);
+    returns it for chaining."""
+    _metrics.REGISTRY.counter("cylon_admission_total",
+                              {"decision": decision.action}).inc()
+    doc = decision.to_dict()
+    _flight.record_admission(doc)
+    if decision.action == "admit":
+        _logger.debug("admission: %s (%s)", decision.action,
+                      decision.reason)
+    else:
+        _logger.warning("admission: %s — %s (worst %s, est %s B vs "
+                        "budget %s B)", decision.action,
+                        decision.reason, decision.worst_node,
+                        decision.est_bytes, decision.budget)
+    return decision
+
+
+def enforce(decision: Decision) -> Decision:
+    """Raise the typed shed error for a shed decision; pass everything
+    else through."""
+    if decision.action == "shed":
+        raise CylonResourceExhausted(
+            f"query shed by admission controller: {decision.reason}; "
+            f"worst node {decision.worst_node} estimated at "
+            f"{decision.est_bytes} B vs budget {decision.budget} B")
+    return decision

@@ -5,11 +5,35 @@ cpp/src/cylon/status.hpp:21-63, cpp/src/cylon/code.cpp) but exposes it
 Python-idiomatically: every public op raises :class:`CylonError` carrying a
 :class:`Code`, and a :class:`Status` object is available for call sites that
 prefer the reference's non-throwing style.
+
+Error taxonomy (docs/resilience.md): the resilience layer needs
+retryability to be a PROPERTY of the error, not a guess made at the
+catch site, so :class:`CylonError` grew four operational subclasses —
+
+* :class:`CylonTransientError`   — a stage that may succeed on retry
+  (preempted ICI collective, transient runtime failure). The ONLY
+  retryable class; ``resilience.retry`` keys off ``retryable``.
+* :class:`CylonResourceExhausted` — HBM/compile memory exhausted, or a
+  query shed by the admission controller. Not retryable as-is: the
+  same attempt would exhaust the same memory — degrade or shrink.
+* :class:`CylonPlanError`        — the plan/query itself is invalid
+  (unknown lowering, bad fault-plan grammar). Never retryable.
+* :class:`CylonDataError`        — malformed input data (truncated
+  parquet, garbage CSV). Never retryable; re-reading won't fix bytes.
+* :class:`CylonTimeoutError`     — the per-query deadline
+  (``CYLON_QUERY_DEADLINE_S``) expired. Never retryable — the budget
+  is spent.
+
+``classify()`` maps raw backend exceptions (XLA RESOURCE_EXHAUSTED,
+preemption/unavailable collectives) onto this taxonomy at the
+resilience layer's catch sites, so retry policy is decided by type,
+never by string-matching in operator code.
 """
 from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
+from typing import Optional
 
 
 class Code(enum.IntEnum):
@@ -59,7 +83,12 @@ class Status:
 
 
 class CylonError(Exception):
-    """Exception carrying a :class:`Code`; the Python-native face of Status."""
+    """Exception carrying a :class:`Code`; the Python-native face of Status.
+
+    ``retryable`` is the class-level contract the resilience layer's
+    retry policy reads: only :class:`CylonTransientError` sets it."""
+
+    retryable = False
 
     def __init__(self, code: Code, msg: str):
         super().__init__(f"[{code.name}] {msg}")
@@ -68,3 +97,85 @@ class CylonError(Exception):
 
     def status(self) -> Status:
         return Status(self.code, self.msg)
+
+
+class CylonTransientError(CylonError):
+    """A stage failure that may succeed on retry (preempted collective,
+    transient runtime error, injected chaos fault). The only retryable
+    error class."""
+
+    retryable = True
+
+    def __init__(self, msg: str, code: Code = Code.ExecutionError):
+        super().__init__(code, msg)
+
+
+class CylonResourceExhausted(CylonError):
+    """HBM/compile memory exhausted, or a query shed by the admission
+    controller. Retrying the identical attempt exhausts the identical
+    memory — the recovery is degrade (blocked/chunked execution) or
+    shrink, never blind retry."""
+
+    def __init__(self, msg: str, code: Code = Code.OutOfMemory):
+        super().__init__(code, msg)
+
+
+class CylonPlanError(CylonError):
+    """The plan/query itself is invalid (no lowering for a node, bad
+    fault-plan grammar, malformed configuration). Never retryable."""
+
+    def __init__(self, msg: str, code: Code = Code.Invalid):
+        super().__init__(code, msg)
+
+
+class CylonDataError(CylonError):
+    """Malformed input data (truncated parquet footer, garbage CSV,
+    invalid UTF-8). Never retryable — re-reading won't fix the bytes."""
+
+    def __init__(self, msg: str, code: Code = Code.SerializationError):
+        super().__init__(code, msg)
+
+
+class CylonTimeoutError(CylonError):
+    """The per-query deadline (``CYLON_QUERY_DEADLINE_S``) expired.
+    Never retryable — the time budget is spent; the flight recorder
+    dumps the in-flight span stack for the post-mortem."""
+
+    def __init__(self, msg: str, code: Code = Code.ExecutionError):
+        super().__init__(code, msg)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """True when retrying the failed stage could succeed: a typed
+    transient error, or a raw backend error ``classify()`` maps to
+    one."""
+    if isinstance(exc, CylonError):
+        return exc.retryable
+    mapped = classify(exc)
+    return mapped is not None and mapped.retryable
+
+
+# substrings (lowercased) in raw backend error text that identify the
+# failure class when the exception TYPE carries no information (XLA
+# surfaces everything as XlaRuntimeError / RuntimeError)
+_TRANSIENT_MARKERS = ("preempt", "unavailable", "aborted",
+                      "connection reset", "transient", "cancelled",
+                      "socket closed")
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted",
+                "out of memory", "failed to allocate")
+
+
+def classify(exc: BaseException) -> Optional[CylonError]:
+    """Map a raw (non-Cylon) exception onto the typed taxonomy, or None
+    when it carries no recognizable operational signature. Typed errors
+    pass through unchanged — classification never re-wraps."""
+    if isinstance(exc, CylonError):
+        return exc
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if any(m in text for m in _OOM_MARKERS):
+        return CylonResourceExhausted(
+            f"backend out of memory: {exc}")
+    if any(m in text for m in _TRANSIENT_MARKERS):
+        return CylonTransientError(
+            f"transient backend failure: {exc}")
+    return None

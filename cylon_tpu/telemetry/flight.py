@@ -30,7 +30,21 @@ disk the moment something goes wrong:
 
 Dumps are written only when ``CYLON_FLIGHT_DIR`` names a directory
 (checked at crash time, so tests/operators can arm it dynamically);
-the ring is always on and costs one deque append per root span.
+the ring is always on and costs one deque append per root span. The
+dump directory is BOUNDED: after each write the oldest dumps beyond
+``CYLON_FLIGHT_MAX_DUMPS`` (default 32) are rotated out, so a
+crash-looping service cannot fill the disk with forensics.
+
+The resilience layer records into two extension points here:
+
+* **admission ring** — ``record_admission()`` keeps the last ring-size
+  admission-controller decisions (admit/degrade/shed); a shed query
+  leaves the same forensic trail as a crashed one.
+* **dump sections** — ``add_dump_section(name, provider)`` registers a
+  zero-arg provider whose result is embedded in every crash dump (the
+  fault injector registers its armed-plan/fired-events state, so a
+  chaos dump names its own cause). Providers that raise contribute an
+  error note, never mask the dump.
 """
 from __future__ import annotations
 
@@ -38,27 +52,36 @@ import json
 import os
 import time
 from collections import deque
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional
 
 from . import ledger as _ledger
 from . import metrics as _metrics
 from . import spans as _spans
 
-DUMP_SCHEMA_VERSION = 1
+DUMP_SCHEMA_VERSION = 2
 
 DEFAULT_RING_SIZE = 16
 
+DEFAULT_MAX_DUMPS = 32
+
 
 def _ring_size() -> int:
-    try:
-        return max(int(os.environ.get("CYLON_FLIGHT_RING",
-                                      DEFAULT_RING_SIZE)), 1)
-    except ValueError:  # pragma: no cover - defensive
-        return DEFAULT_RING_SIZE
+    return _metrics.env_number("CYLON_FLIGHT_RING", DEFAULT_RING_SIZE,
+                               lo=1, as_int=True)
+
+
+def _max_dumps() -> int:
+    return _metrics.env_number("CYLON_FLIGHT_MAX_DUMPS",
+                               DEFAULT_MAX_DUMPS, lo=1, as_int=True)
 
 
 _ring: deque = deque(maxlen=_ring_size())
+_admissions: deque = deque(maxlen=_ring_size())
 _dump_seq = 0
+
+# crash-dump section providers: name -> zero-arg callable returning a
+# JSON-able value (resilience/inject registers its fault state here)
+_dump_sections: Dict[str, Callable[[], object]] = {}
 
 
 def recent() -> List[object]:
@@ -69,6 +92,28 @@ def recent() -> List[object]:
 def last_dump_path() -> Optional[str]:
     """Path of the most recent crash dump this process wrote, or None."""
     return getattr(_on_root_close, "_last_dump", None)
+
+
+def record_admission(doc: dict) -> None:
+    """Append one admission-controller decision to the admission ring
+    (bounded like the query ring; included in every crash dump)."""
+    _admissions.append(dict(doc))
+
+
+def admissions() -> List[dict]:
+    """The most recent admission decisions, oldest first."""
+    return [dict(d) for d in _admissions]
+
+
+def add_dump_section(name: str, provider: Callable[[], object]) -> None:
+    """Register a named crash-dump section: ``provider()`` runs at dump
+    time and its result is embedded under ``sections[name]``. Last
+    registration per name wins."""
+    _dump_sections[name] = provider
+
+
+def remove_dump_section(name: str) -> None:
+    _dump_sections.pop(name, None)
 
 
 def error_path(root) -> List[object]:
@@ -97,7 +142,7 @@ def _pool_watermarks() -> dict:
                 "bytes_limit": int(limit),
                 "available_bytes": pool.available_bytes(),
                 "comm_budget_bytes": pool.comm_budget_bytes()}
-    except Exception:  # pragma: no cover - defensive
+    except Exception:  # pragma: no cover - defensive  # cylint: disable=errors/broad-swallow — watermarks are optional forensics
         return {}
 
 
@@ -109,7 +154,7 @@ def _environment() -> dict:
     try:
         backend = jax.default_backend()
         n_devices = jax.device_count()
-    except Exception:  # pragma: no cover - defensive
+    except Exception:  # pragma: no cover - defensive  # cylint: disable=errors/broad-swallow — environment probe is optional forensics
         backend, n_devices = None, None
     return {"env": env, "backend": backend, "device_count": n_devices,
             "pid": os.getpid()}
@@ -118,6 +163,12 @@ def _environment() -> dict:
 def crash_dump_doc(root) -> dict:
     """The crash-dump document for one errored root span (pure —
     write_crash_dump serializes it; tests inspect it directly)."""
+    sections = {}
+    for name, provider in list(_dump_sections.items()):
+        try:
+            sections[name] = provider()
+        except Exception as e:  # pragma: no cover - defensive  # cylint: disable=errors/broad-swallow — a failing section provider must not mask the dump
+            sections[name] = {"error": f"{type(e).__name__}: {e}"}
     return {
         "kind": "cylon-flight-crash-dump",
         "version": DUMP_SCHEMA_VERSION,
@@ -129,6 +180,8 @@ def crash_dump_doc(root) -> dict:
         "pool": _pool_watermarks(),
         "ledger_outstanding": _ledger.outstanding(),
         "recent_queries": [s.label for s in _ring],
+        "admissions": list(admissions()),
+        "sections": sections,
         "environment": _environment(),
     }
 
@@ -155,10 +208,42 @@ def write_crash_dump(root, directory: Optional[str] = None
         _spans.logger.warning("flight recorder: crash dump written to %s",
                               path)
         _on_root_close._last_dump = path
+        _rotate_dumps(directory)
         return path
     except Exception:  # pragma: no cover - defensive
         _spans.logger.exception("flight recorder: crash dump failed")
         return None
+
+
+def _rotate_dumps(directory: str) -> None:
+    """Bound the dump directory to ``CYLON_FLIGHT_MAX_DUMPS`` files:
+    delete the oldest ``cylon-crash-*.json`` beyond the cap (by mtime,
+    name as the tiebreak) so a crash-looping service cannot fill the
+    disk with forensics. Never raises — rotation is best-effort."""
+    try:
+        cap = _max_dumps()
+        dumps = []
+        for name in os.listdir(directory):
+            if name.startswith("cylon-crash-") and \
+                    name.endswith(".json"):
+                p = os.path.join(directory, name)
+                try:
+                    dumps.append((os.path.getmtime(p), name, p))
+                except OSError:  # pragma: no cover - raced deletion
+                    continue
+        if len(dumps) <= cap:
+            return
+        dumps.sort()
+        for _mtime, _name, p in dumps[:len(dumps) - cap]:
+            try:
+                os.remove(p)
+            except OSError:  # pragma: no cover - raced deletion
+                continue
+        _spans.logger.warning(
+            "flight recorder: rotated %d old crash dump(s) "
+            "(CYLON_FLIGHT_MAX_DUMPS=%d)", len(dumps) - cap, cap)
+    except Exception:  # pragma: no cover - defensive
+        _spans.logger.exception("flight recorder: dump rotation failed")
 
 
 def _on_root_close(root) -> None:
@@ -166,11 +251,12 @@ def _on_root_close(root) -> None:
         # dump BEFORE ring insertion so recent_queries lists the
         # queries that PRECEDED the failure
         write_crash_dump(root)
-    if root.name == "plan.preflight":
-        # the default execute() path emits this warning marker as a
-        # parentless span; it is not a query tree — letting it into
-        # the ring would evict the real query history the forensics
-        # depend on
+    if root.name in ("plan.preflight", "plan.admission"):
+        # the default execute() path emits these warning/decision
+        # markers as parentless spans; they are not query trees —
+        # letting them into the ring would evict the real query
+        # history the forensics depend on (admission decisions have
+        # their own ring: record_admission)
         return
     _ring.append(root)
 
@@ -181,6 +267,8 @@ _spans.add_root_hook(_on_root_close)
 
 
 def reset() -> None:
-    """Clear the ring (test isolation); re-reads the ring-size env."""
-    global _ring
+    """Clear the query + admission rings (test isolation); re-reads the
+    ring-size env."""
+    global _ring, _admissions
     _ring = deque(maxlen=_ring_size())
+    _admissions = deque(maxlen=_ring_size())

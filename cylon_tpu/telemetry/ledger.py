@@ -100,7 +100,7 @@ def _buffer_bytes(arr) -> int:
     try:
         return int(np.dtype(arr.dtype).itemsize) * \
             int(np.prod(arr.shape))
-    except Exception:  # pragma: no cover - exotic leaf
+    except Exception:  # pragma: no cover - exotic leaf  # cylint: disable=errors/broad-swallow — exotic leaf contributes 0 bytes
         return 0
 
 
@@ -115,7 +115,7 @@ def _charge_buffers(table) -> tuple:
     global _live_total
     try:
         bufs = table.buffers()
-    except Exception:
+    except Exception:  # cylint: disable=errors/broad-swallow — no buffers() enumeration: nothing distinct
         return ()
     ids = []
     for b in bufs:
@@ -154,7 +154,7 @@ def track(table, owner: str, borrowed: bool = False):
         return table
     try:
         nbytes = int(table.nbytes)
-    except Exception:  # pragma: no cover - defensive (cleared tables)
+    except Exception:  # pragma: no cover - defensive (cleared tables)  # cylint: disable=errors/broad-swallow — cleared table tracks at 0 bytes
         nbytes = 0
     cur = _spans.current_span()
     root_id = cur.root_id if cur is not None else 0

@@ -25,10 +25,27 @@ Well-known series (full catalog: docs/telemetry.md):
 from __future__ import annotations
 
 import functools
+import os
 import threading
 from typing import Callable, Dict, Optional, Tuple
 
 LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def env_number(name: str, default, lo=None, as_int: bool = False):
+    """Numeric env-var knob with a floor and a silent fallback — THE
+    parse for every CYLON_* tuning variable (flight ring/dump caps,
+    retry budget/backoff, shed factor): unset or malformed reads as
+    ``default``, ``lo`` floors the result. One copy, so a future
+    policy change (logging malformed values, say) lands everywhere."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        v = int(raw) if as_int else float(raw)
+    except ValueError:
+        return default
+    return max(v, lo) if lo is not None else v
 
 
 class Counter:
@@ -239,10 +256,23 @@ def get_memory_pool():
 # attribute so metrics (a leaf of the leaf) never imports profiler.
 _factory_build_hook: Optional[Callable] = None
 
+# Fault hook for the chaos injector (resilience/inject.py): when
+# installed, ``hook(factory_name)`` runs BEFORE each counted_cache
+# build and may raise a typed error — the deterministic stand-in for a
+# compile OOM. lru_cache never caches exceptions, so a faulted build
+# rebuilds cleanly on retry. Duck-typed like the build hook: telemetry
+# stays a base-layer leaf and never imports resilience.
+_factory_fault_hook: Optional[Callable] = None
+
 
 def set_factory_build_hook(hook: Optional[Callable]) -> None:
     global _factory_build_hook
     _factory_build_hook = hook
+
+
+def set_factory_fault_hook(hook: Optional[Callable]) -> None:
+    global _factory_fault_hook
+    _factory_fault_hook = hook
 
 
 def counted_cache(fn: Callable) -> Callable:
@@ -256,6 +286,9 @@ def counted_cache(fn: Callable) -> Callable:
                          {"factory": fn.__name__})
 
     def _build(*args, **kwargs):
+        fault = _factory_fault_hook
+        if fault is not None:
+            fault(fn.__name__)  # chaos: may raise an injected error
         c.inc()
         out = fn(*args, **kwargs)
         hook = _factory_build_hook
@@ -266,7 +299,7 @@ def counted_cache(fn: Callable) -> Callable:
     cached = functools.lru_cache(maxsize=None)(_build)
     try:
         functools.update_wrapper(cached, fn)
-    except Exception:  # pragma: no cover - exotic callables
+    except Exception:  # pragma: no cover - exotic callables  # cylint: disable=errors/broad-swallow — exotic callable keeps its bare wrapper
         pass
     return cached
 

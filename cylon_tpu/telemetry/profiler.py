@@ -56,7 +56,7 @@ def _cost_analysis(compiled):
     jax version."""
     try:
         ca = compiled.cost_analysis()
-    except Exception:
+    except Exception:  # cylint: disable=errors/broad-swallow — cost_analysis is best-effort
         return None, None
     if isinstance(ca, (list, tuple)):
         ca = ca[0] if ca else None
@@ -102,13 +102,13 @@ class _ProfiledProgram:
                 # proxy must be transparent, not AOT-compile
                 return self._fn(*args)
             key = _signature(args)
-        except Exception:
+        except Exception:  # cylint: disable=errors/broad-swallow — non-lowerable program falls back to bare jit
             return self._fn(*args)
         hit = self._compiled.get(key)
         if hit is not None:
             try:
                 return hit(*args)
-            except Exception:
+            except Exception:  # cylint: disable=errors/broad-swallow — cost_analysis is best-effort
                 # evict: a signature whose executable rejects dispatch
                 # (sharding/commitment drift) must not pay a failed
                 # AOT call on every subsequent exchange
@@ -121,7 +121,7 @@ class _ProfiledProgram:
             t0 = time.perf_counter()
             compiled = lowered.compile()
             dt = time.perf_counter() - t0
-        except Exception:
+        except Exception:  # cylint: disable=errors/broad-swallow — compile() unsupported: bare jit fallback
             # tracers (make_jaxpr/abstract eval), non-jit callables,
             # backends without AOT support: profiling bows out
             return self._fn(*args)
@@ -130,7 +130,7 @@ class _ProfiledProgram:
         self._compiled[key] = compiled
         try:
             return compiled(*args)
-        except Exception:
+        except Exception:  # cylint: disable=errors/broad-swallow — cost dict shape varies by backend
             # aval/sharding subtleties the signature key missed: the
             # jit object remains the source of truth
             del self._compiled[key]

@@ -241,7 +241,7 @@ def span(name: str, seq: Optional[int] = None, **attrs) -> Iterator[Span]:
     if pool is not None:
         try:
             s._hbm0 = int(pool.snapshot()[0])
-        except Exception:  # pragma: no cover - defensive
+        except Exception:  # pragma: no cover - defensive  # cylint: disable=errors/broad-swallow — pool snapshot failure disables hbm attrs
             s._hbm0 = None
     token = _current.set(s)
     s._t0 = time.perf_counter()
@@ -260,7 +260,7 @@ def span(name: str, seq: Optional[int] = None, **attrs) -> Iterator[Span]:
                 used, peak, _limit = pool.snapshot()
                 s.attrs["hbm_delta"] = int(used) - s._hbm0
                 s.attrs["hbm_peak"] = int(peak)
-            except Exception:  # pragma: no cover - defensive
+            except Exception:  # pragma: no cover - defensive  # cylint: disable=errors/broad-swallow — pool snapshot failure drops hbm attrs
                 pass
         _metrics.observe_phase(s.name, s.elapsed_ms, error=s.error)
         for sink in list(_sinks):

@@ -1,0 +1,395 @@
+#!/usr/bin/env python
+"""Chaos drill: seeded fault plans swept through the bench pipeline.
+
+Proves the resilience layer END TO END, deterministically (wired into
+scripts/check.sh after the telemetry smoke gate):
+
+* ``compile``    — an injected transient fault at the first kernel-
+  factory build is retried to success (lru_cache never caches the
+  exception, so the retry rebuilds); result bit-matches the clean run.
+* ``transient``  — an injected transient exchange fault (arrival index
+  varied by seed) is retried to success: ``cylon_retries_total`` > 0,
+  ``[RETRY×n]`` in EXPLAIN ANALYZE, result matches the clean run.
+* ``persistent`` — a persistent exchange fault exhausts the retry
+  budget and surfaces as a TYPED ``CylonTransientError`` (never a raw
+  traceback) plus a parseable crash dump whose ``faults`` section
+  names the injected site.
+* ``shed``       — a chaos-clamped budget makes the admission
+  controller SHED the query with ``CylonResourceExhausted`` before any
+  device work; the decision lands in the flight admission ring.
+* ``degrade``    — a moderately clamped budget on a single-shard plan
+  DEGRADES the join to the blocked/chunked path; the result matches
+  the clean run.
+* ``deadline``   — a ~zero ``CYLON_QUERY_DEADLINE_S`` surfaces as a
+  typed ``CylonTimeoutError`` with a crash dump.
+
+Every scenario asserts ZERO ledger leaks after its results are
+dropped — retry, shed and degrade paths must not strand HBM.
+
+Usage::
+
+    python scripts/chaos.py --seeds 3            # the check.sh gate
+    python scripts/chaos.py --seed 1             # replay one seed
+    python scripts/chaos.py --seed 1 --scenario persistent
+
+Each seed runs in a fresh subprocess (cold kernel-factory caches make
+the ``compile`` arrival index deterministic); a failure prints the
+fault plan + the one-command replay line.
+"""
+import argparse
+import gc
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+# fast, deterministic backoff for the drill
+os.environ.setdefault("CYLON_RETRY_BACKOFF_S", "0.001")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SCENARIOS = ("compile", "transient", "persistent", "shed", "degrade",
+             "deadline")
+
+
+class ChaosFailure(AssertionError):
+    pass
+
+
+def _check(ok, msg, scenario, seed, plan):
+    if not ok:
+        raise ChaosFailure(
+            f"[{scenario}] {msg}\n"
+            f"  fault plan: {plan!r}\n"
+            f"  replay: CYLON_FAULT_PLAN={plan or ''!r} python "
+            f"scripts/chaos.py --seed {seed} --scenario {scenario}")
+
+
+# ---------------------------------------------------------------------------
+# child: one seed, fresh process
+# ---------------------------------------------------------------------------
+
+
+def _tables(ct, ctx, n, seed):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    left = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n // 4, n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32),
+        "z": rng.integers(0, 50, n).astype(np.int32)})
+    right = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n // 4, n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32)})
+    return left, right
+
+
+def _pipe(plan, left, right):
+    return plan.scan(left).join(plan.scan(right), on="k") \
+        .groupby("lt-2", ["rt-4"], ["sum"])
+
+
+def _result_rows(table):
+    import numpy as np
+
+    d = table.to_pydict()
+    ks = sorted(d)
+    rows = sorted(zip(*(np.asarray(d[k]).tolist() for k in ks)))
+    return ks, rows
+
+
+def _same_result(a, b) -> bool:
+    import numpy as np
+
+    (ka, ra), (kb, rb) = _result_rows(a), _result_rows(b)
+    if ka != kb or len(ra) != len(rb):
+        return False
+    return all(np.allclose(x, y, rtol=1e-5, atol=1e-5)
+               for x, y in zip(np.asarray(ra, dtype=np.float64).T,
+                               np.asarray(rb, dtype=np.float64).T))
+
+
+def _retries(telemetry) -> int:
+    snap = telemetry.metrics_snapshot()
+    return sum(v for k, v in snap.items()
+               if k.startswith("cylon_retries_total"))
+
+
+def _leak_check(ledger, held, scenario, seed, plan):
+    """Zero NEW leaks: after a scenario drops its results, the live
+    non-borrowed entry count must return to ``held`` (the deliberately
+    held baseline result)."""
+    gc.collect()
+    _check(ledger.leak_count() == held,
+           f"ledger leaks after scenario (expected {held} held "
+           f"entries): {ledger.outstanding()}",
+           scenario, seed, plan)
+
+
+def run_seed(seed: int, only=None) -> dict:
+    import cylon_tpu as ct
+    from cylon_tpu import plan, telemetry
+    from cylon_tpu.resilience import inject
+    from cylon_tpu.telemetry import flight, ledger
+
+    n = 2048 + 256 * (seed % 4)
+    ctx = ct.CylonContext.InitDistributed(ct.TPUConfig(world_size=4))
+    left, right = _tables(ct, ctx, n, seed)
+    ran = {}
+
+    def wants(name):
+        return only is None or name == only
+
+    # -- compile: first kernel-factory build faults, retried ----------
+    # MUST run first: arrival 1 is only the first build while the
+    # process's factory caches are cold
+    if wants("compile"):
+        fp = "compile:1:transient"
+        inject.arm(fp)
+        r0 = _retries(telemetry)
+        try:
+            txt = _pipe(plan, left, right).explain(analyze=True)
+        finally:
+            inject.disarm()
+        _check(_retries(telemetry) > r0,
+               "no retry recorded for the injected compile fault",
+               "compile", seed, fp)
+        _check("[RETRY" in txt,
+               f"no [RETRY marker in EXPLAIN ANALYZE:\n{txt}",
+               "compile", seed, fp)
+        ran["compile"] = {"retries": _retries(telemetry) - r0}
+
+    # clean baseline (after `compile` so its arrival index stays cold)
+    baseline = _pipe(plan, left, right).execute()
+
+    if wants("compile") and "compile" in ran:
+        # the faulted-and-retried run must have produced honest output
+        redo = _pipe(plan, left, right).execute()
+        _check(_same_result(redo, baseline),
+               "post-compile-fault execution diverges from baseline",
+               "compile", seed, "compile:1:transient")
+        del redo
+
+    # every tracked entry live PAST this point that is not the held
+    # baseline result is a leak
+    gc.collect()
+    held = ledger.leak_count()
+
+    # -- transient: Nth exchange launch faults, retried ---------------
+    if wants("transient"):
+        nth = 1 + seed % 2
+        fp = f"exchange:{nth}:transient"
+        inject.arm(fp)
+        r0 = _retries(telemetry)
+        p = _pipe(plan, left, right)
+        try:
+            txt = p.explain(analyze=True)
+            result = p.execute()
+        finally:
+            inject.disarm()
+        _check(_retries(telemetry) > r0,
+               "no retry recorded for the injected exchange fault",
+               "transient", seed, fp)
+        _check("[RETRY" in txt,
+               f"no [RETRY marker in EXPLAIN ANALYZE:\n{txt}",
+               "transient", seed, fp)
+        _check(_same_result(result, baseline),
+               "retried run diverges from clean baseline",
+               "transient", seed, fp)
+        del result
+        _leak_check(ledger, held, "transient", seed, fp)
+        ran["transient"] = {"retries": _retries(telemetry) - r0,
+                            "nth": nth}
+
+    # -- persistent: every exchange attempt faults -> typed + dump ----
+    if wants("persistent"):
+        fp = "exchange:1+:transient"
+        dump_dir = tempfile.mkdtemp(prefix="cylon-chaos-")
+        os.environ["CYLON_FLIGHT_DIR"] = dump_dir
+        inject.arm(fp)
+        err_text = None
+        try:
+            # capture TEXT, never the exception object: its traceback
+            # would pin the executor frames (and their intermediate
+            # tables) past the leak check below
+            try:
+                _pipe(plan, left, right).explain(analyze=True)
+            except ct.CylonTransientError as e:
+                err_text = str(e)
+            except Exception as e:  # noqa: BLE001 - asserted below
+                _check(False, f"expected CylonTransientError, got "
+                       f"{type(e).__name__}: {e}", "persistent", seed,
+                       fp)
+            else:
+                _check(False, "persistent fault did not fail the query",
+                       "persistent", seed, fp)
+        finally:
+            fault_state = inject.state()
+            inject.disarm()
+            os.environ.pop("CYLON_FLIGHT_DIR", None)
+        _check("injected transient fault at exchange" in err_text,
+               f"error does not name the fault: {err_text}",
+               "persistent", seed, fp)
+        dumps = [f for f in os.listdir(dump_dir) if f.endswith(".json")]
+        _check(len(dumps) == 1, f"expected one crash dump, found "
+               f"{dumps}", "persistent", seed, fp)
+        doc = json.load(open(os.path.join(dump_dir, dumps[0])))
+        faults = doc.get("sections", {}).get("faults", {})
+        _check(any(f.get("site") == "exchange"
+                   for f in faults.get("fired", [])),
+               f"crash dump faults section does not name the exchange "
+               f"site: {faults}", "persistent", seed, fp)
+        _check(any(s["name"].startswith("plan.")
+                   for s in doc.get("error_path", [])),
+               f"crash dump error path has no plan span: "
+               f"{[s['name'] for s in doc.get('error_path', [])]}",
+               "persistent", seed, fp)
+        _leak_check(ledger, held, "persistent", seed, fp)
+        ran["persistent"] = {"fired": len(fault_state["fired"]),
+                             "dump": dumps[0]}
+
+    # -- shed: clamped budget -> admission sheds before device work ---
+    if wants("shed"):
+        fp = "pool:4096:oom"
+        inject.arm(fp)
+        err_text = None
+        try:
+            try:
+                _pipe(plan, left, right).execute(analyze=True)
+            except ct.CylonResourceExhausted as e:
+                err_text = str(e)
+            else:
+                _check(False, "over-budget query was not shed", "shed",
+                       seed, fp)
+        finally:
+            inject.disarm()
+        _check("shed by admission controller" in err_text,
+               f"unexpected shed error text: {err_text}", "shed", seed,
+               fp)
+        last = flight.admissions()[-1] if flight.admissions() else {}
+        _check(last.get("action") == "shed",
+               f"admission ring does not record the shed: {last}",
+               "shed", seed, fp)
+        _leak_check(ledger, held, "shed", seed, fp)
+        ran["shed"] = {"decision": last}
+
+    # -- degrade: single-shard join over budget -> blocked path -------
+    if wants("degrade"):
+        fp = "pool:32768:oom"
+        lctx = ct.CylonContext.Init()
+        l2, r2 = _tables(ct, lctx, n, seed + 100)
+        lpipe = plan.scan(l2).join(plan.scan(r2), on="k")
+        clean = lpipe.execute()
+        inject.arm(fp)
+        try:
+            p = plan.scan(l2).join(plan.scan(r2), on="k")
+            degraded = p.execute(analyze=True)
+            rep = p.last_report
+        finally:
+            inject.disarm()
+        _check(rep.admission is not None
+               and rep.admission.get("action") == "degrade",
+               f"admission did not degrade: {rep.admission}",
+               "degrade", seed, fp)
+        _check(_same_result(degraded, clean),
+               "degraded (blocked) join diverges from clean join",
+               "degrade", seed, fp)
+        last = flight.admissions()[-1] if flight.admissions() else {}
+        _check(last.get("action") == "degrade",
+               f"admission ring does not record the degrade: {last}",
+               "degrade", seed, fp)
+        del degraded, clean
+        _leak_check(ledger, held, "degrade", seed, fp)
+        ran["degrade"] = {"decision": last}
+
+    # -- deadline: ~zero budget -> typed timeout + dump ---------------
+    if wants("deadline"):
+        dump_dir = tempfile.mkdtemp(prefix="cylon-chaos-")
+        os.environ["CYLON_FLIGHT_DIR"] = dump_dir
+        os.environ["CYLON_QUERY_DEADLINE_S"] = "0.000001"
+        err_text = None
+        try:
+            try:
+                _pipe(plan, left, right).execute(analyze=True)
+            except ct.CylonTimeoutError as e:
+                err_text = str(e)
+            else:
+                _check(False, "zero deadline did not time the query "
+                       "out", "deadline", seed, None)
+        finally:
+            os.environ.pop("CYLON_QUERY_DEADLINE_S", None)
+            os.environ.pop("CYLON_FLIGHT_DIR", None)
+        _check("deadline exceeded" in err_text,
+               f"unexpected timeout text: {err_text}", "deadline",
+               seed, None)
+        dumps = [f for f in os.listdir(dump_dir) if f.endswith(".json")]
+        _check(len(dumps) == 1,
+               f"expected one crash dump, found {dumps}", "deadline",
+               seed, None)
+        _leak_check(ledger, held, "deadline", seed, None)
+        ran["deadline"] = {"dump": dumps[0]}
+
+    del baseline
+    gc.collect()
+    return ran
+
+
+# ---------------------------------------------------------------------------
+# parent: sweep seeds in fresh subprocesses
+# ---------------------------------------------------------------------------
+
+
+def sweep(seeds: int, scenario=None) -> int:
+    for seed in range(seeds):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--seed", str(seed)]
+        if scenario:
+            cmd += ["--scenario", scenario]
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=900)
+        if r.returncode != 0:
+            sys.stderr.write(r.stdout)
+            sys.stderr.write(r.stderr)
+            print(f"chaos: FAIL at seed {seed} — replay with: "
+                  f"python scripts/chaos.py --seed {seed}"
+                  + (f" --scenario {scenario}" if scenario else ""),
+                  file=sys.stderr)
+            return 1
+        # last stdout line is the child's JSON summary
+        tail = [l for l in r.stdout.splitlines() if l.strip()]
+        print(f"chaos: seed {seed} OK — "
+              f"{tail[-1] if tail else '(no summary)'}")
+    print(f"chaos: OK — {seeds} seed(s), all scenarios deterministic")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python scripts/chaos.py",
+        description="seeded chaos drill over the resilience layer "
+                    "(docs/resilience.md)")
+    p.add_argument("--seeds", type=int,
+                   help="sweep seeds 0..N-1, one fresh subprocess each")
+    p.add_argument("--seed", type=int,
+                   help="run ONE seed in this process (the child/"
+                        "replay mode)")
+    p.add_argument("--scenario", choices=SCENARIOS,
+                   help="restrict to one scenario")
+    args = p.parse_args(argv)
+    if args.seed is not None:
+        ran = run_seed(args.seed, only=args.scenario)
+        print(json.dumps({"seed": args.seed, "scenarios": ran},
+                         default=str))
+        return 0
+    return sweep(args.seeds or 3, scenario=args.scenario)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

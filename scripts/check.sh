@@ -5,9 +5,9 @@ set -e
 cd "$(dirname "$0")/.."
 
 echo "== static analysis: python -m cylon_tpu.analysis =="
-# all six checker families (layering, hostsync, collectives, witness,
-# span-coverage, ledger-coverage); any unsuppressed finding fails the
-# gate before tests
+# all seven checker families (layering, hostsync, collectives, witness,
+# span-coverage, ledger-coverage, errors); any unsuppressed finding
+# fails the gate before tests
 python -m cylon_tpu.analysis
 
 echo "== telemetry smoke: scripts/smoke_telemetry.py =="
@@ -20,6 +20,16 @@ echo "== telemetry smoke: scripts/smoke_telemetry.py =="
 # deliberately failing query must leave a parseable crash dump (span
 # stack, metrics, nonzero pool watermark, ledger outstanding set)
 python scripts/smoke_telemetry.py
+
+echo "== chaos drill: scripts/chaos.py --seeds 3 =="
+# seeded fault plans through the bench pipeline: transient faults must
+# retry to success ([RETRY] in EXPLAIN ANALYZE), persistent faults must
+# fail TYPED with a parseable crash dump naming the fault site, an
+# over-budget query must be shed or degraded by the admission
+# controller, a zero deadline must time out typed — all deterministic
+# per seed, zero ledger leaks on every path; failures print the fault
+# plan + seed for one-command replay
+python scripts/chaos.py --seeds 3
 
 echo "== bench trend: scripts/benchtrend.py --check =="
 # the committed BENCH_r*.json trajectory must parse, render, and show

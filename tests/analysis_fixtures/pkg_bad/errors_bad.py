@@ -1,0 +1,70 @@
+"""Seeded violations for the ``errors`` family (exact-set pinned in
+tests/test_analysis.py). Line numbers are load-bearing."""
+import logging
+
+logger = logging.getLogger("pkg_bad")
+
+
+def bare_swallow():
+    try:
+        return 1 / 0
+    except:  # seeded: errors/bare-except (line 11)
+        return None
+
+
+def broad_swallow():
+    try:
+        return 1 / 0
+    except Exception:  # seeded: errors/broad-swallow (line 18)
+        return None
+
+
+def broad_swallow_base():
+    try:
+        return 1 / 0
+    except BaseException:  # seeded: errors/broad-swallow (line 25)
+        return None
+
+
+def broad_swallow_tuple():
+    try:
+        return 1 / 0
+    except (ValueError, Exception):  # seeded: errors/broad-swallow (line 32)
+        return None
+
+
+def broad_but_reraises():  # clean: re-raise is not a swallow
+    try:
+        return 1 / 0
+    except Exception:
+        raise
+
+
+def broad_but_logs():  # clean: logger.exception reports the failure
+    try:
+        return 1 / 0
+    except Exception:
+        logger.exception("probe failed")
+        return None
+
+
+def broad_but_marks_span(sp):  # clean: error=True span attr reports it
+    try:
+        return 1 / 0
+    except Exception:
+        sp.set(error=True)
+        return None
+
+
+def narrow_is_fine():  # clean: a named exception class is in scope
+    try:
+        return 1 / 0
+    except ZeroDivisionError:
+        return None
+
+
+def deliberate_fallback():  # suppressed: explicit per-line opt-out
+    try:
+        return 1 / 0
+    except Exception:  # cylint: disable=errors/broad-swallow — seeded suppression
+        return None

@@ -123,6 +123,52 @@ def test_ledgercov_real_tree_clean():
 
 
 # ---------------------------------------------------------------------------
+# errors (no silent swallowing)
+# ---------------------------------------------------------------------------
+
+
+def test_errors_fixture_reports_exactly_seeded():
+    """Bare excepts and broad swallows are findings; re-raising,
+    logging, error=True span marking and narrow handlers are not; the
+    deliberate fallback's per-line opt-out counts as suppressed."""
+    res = run_checkers(AnalysisContext(PKG_BAD), families=["errors"])
+    got = {(f.path, f.line, f.rule) for f in res.findings}
+    assert got == {
+        ("errors_bad.py", 11, "errors/bare-except"),
+        ("errors_bad.py", 18, "errors/broad-swallow"),
+        ("errors_bad.py", 25, "errors/broad-swallow"),
+        ("errors_bad.py", 32, "errors/broad-swallow"),
+    }, res.format_text()
+    assert res.suppressed == 1
+
+
+def test_errors_real_tree_clean():
+    """Every broad handler in the real package either reports through
+    the telemetry error channel or carries an explicit per-line
+    opt-out documenting the deliberate fallback — silent swallowing
+    is never the default."""
+    res = run_checkers(AnalysisContext(PKG_REAL), families=["errors"])
+    assert res.findings == [], res.format_text()
+    # the deliberate defensive fallbacks are visible as suppressions,
+    # not invisible as accepted defaults
+    assert res.suppressed >= 10
+
+
+def test_errors_family_in_fixture_cli_default():
+    """`python -m cylon_tpu.analysis --package-root <fixture>` runs the
+    errors family by default and fails on the seeded swallows."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "cylon_tpu.analysis", "--package-root",
+         PKG_BAD],
+        capture_output=True, text=True, cwd=os.path.dirname(PKG_REAL),
+        env=env, timeout=300)
+    assert r.returncode == 1
+    assert "[errors/bare-except]" in r.stdout
+    assert "[errors/broad-swallow]" in r.stdout
+
+
+# ---------------------------------------------------------------------------
 # hostsync
 # ---------------------------------------------------------------------------
 

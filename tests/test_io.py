@@ -79,6 +79,101 @@ def test_missing_file_raises(local_ctx):
     assert e.value.code == ct.Code.IOError
 
 
+def test_missing_parquet_raises_ioerror(local_ctx):
+    with pytest.raises(ct.CylonError) as e:
+        ct.read_parquet(local_ctx, "/nonexistent/file.parquet")
+    assert e.value.code == ct.Code.IOError
+    # missing-file is NOT a data error — the taxonomy distinguishes
+    assert not isinstance(e.value, ct.CylonDataError)
+
+
+def test_truncated_parquet_raises_data_error(local_ctx, tmp_path):
+    """A truncated parquet footer is malformed DATA: a typed
+    CylonDataError naming the file, never a pyarrow traceback."""
+    df = pd.DataFrame({"a": np.arange(1000), "b": np.ones(1000)})
+    t = ct.Table.from_pandas(local_ctx, df)
+    p = tmp_path / "t.parquet"
+    t.to_parquet(str(p))
+    blob = p.read_bytes()
+    p.write_bytes(blob[: len(blob) // 2])          # chop the footer
+    with pytest.raises(ct.CylonDataError) as e:
+        ct.read_parquet(local_ctx, str(p))
+    assert "t.parquet" in str(e.value)
+    assert e.value.retryable is False
+
+
+def test_garbage_parquet_raises_data_error(local_ctx, tmp_path):
+    p = tmp_path / "garbage.parquet"
+    p.write_bytes(b"\x00\xffnot a parquet file at all\x13\x37" * 64)
+    with pytest.raises(ct.CylonDataError):
+        ct.read_parquet(local_ctx, str(p))
+
+
+def test_garbage_csv_raises_data_error(local_ctx, tmp_path):
+    """Structurally broken CSV (ragged binary rows) fails the parse —
+    typed CylonDataError, not a backend traceback."""
+    p = tmp_path / "garbage.csv"
+    p.write_bytes(b"a,b\n\x00\x01binary\xffjunk\n\x13\x37")
+    with pytest.raises(ct.CylonDataError) as e:
+        ct.read_csv(local_ctx, str(p))
+    assert "garbage.csv" in str(e.value)
+
+
+def test_csv_type_mismatch_raises_data_error(local_ctx, tmp_path):
+    """A declared column type the cells cannot convert to is malformed
+    input, same taxonomy."""
+    from cylon_tpu.dtypes import Int64
+
+    p = tmp_path / "badtypes.csv"
+    p.write_text("a,b\nnot_an_int,1\nalso_not,2\n")
+    opts = ct.CSVReadOptions().WithColumnTypes(
+        {"a": Int64(), "b": Int64()})
+    with pytest.raises(ct.CylonDataError):
+        ct.read_csv(local_ctx, str(p), opts)
+
+
+def test_ingest_fault_injection_site(local_ctx, tmp_path):
+    """The chaos injector's `ingest` choke point fires inside the
+    readers with a typed error; a data fault is non-retryable and
+    leaves on the first attempt."""
+    from cylon_tpu.resilience import inject
+
+    p = tmp_path / "ok.csv"
+    pd.DataFrame({"a": [1, 2]}).to_csv(p, index=False)
+    inject.arm("ingest:1:data")
+    try:
+        with pytest.raises(ct.CylonDataError,
+                           match="injected data fault at ingest"):
+            ct.read_csv(local_ctx, str(p))
+        # arrival 2: reads fine
+        assert ct.read_csv(local_ctx, str(p)).row_count == 2
+    finally:
+        inject.disarm()
+
+
+def test_ingest_transient_fault_retries(local_ctx, tmp_path,
+                                        monkeypatch):
+    """A TRANSIENT ingest fault retries under the bounded policy and
+    the read succeeds — the documented ingest retry seam."""
+    from cylon_tpu import telemetry
+    from cylon_tpu.resilience import inject
+
+    monkeypatch.setenv("CYLON_RETRY_BACKOFF_S", "0.0")
+    p = tmp_path / "flaky.parquet"
+    t = ct.Table.from_pandas(local_ctx, pd.DataFrame({"a": [1, 2, 3]}))
+    t.to_parquet(str(p))
+    before = telemetry.metrics_snapshot().get(
+        'cylon_retries_total{site="ingest"}', 0)
+    inject.arm("ingest:1:transient")
+    try:
+        out = ct.read_parquet(local_ctx, str(p))
+    finally:
+        inject.disarm()
+    assert out.row_count == 3
+    assert telemetry.metrics_snapshot().get(
+        'cylon_retries_total{site="ingest"}', 0) - before == 1
+
+
 def test_write_csv_nan_matches_fallback(local_ctx, tmp_path):
     """Non-null NaN float cells serialize identically (empty field) on
     the native writer and the pandas fallback."""

@@ -1,0 +1,487 @@
+"""Resilience-layer tests: the typed error taxonomy, deterministic
+fault injection, retry-with-backoff, the per-query deadline, and the
+admission controller's admit/degrade/shed decisions."""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import plan, telemetry
+from cylon_tpu.resilience import admission, inject, retry
+from cylon_tpu.status import (Code, CylonDataError, CylonError,
+                              CylonPlanError, CylonResourceExhausted,
+                              CylonTimeoutError, CylonTransientError,
+                              classify, is_retryable)
+from cylon_tpu.telemetry import flight, ledger
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    inject.disarm()
+
+
+def _table(ctx, n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    return ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, max(n // 4, 1), n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32)})
+
+
+def _counter(name_prefix):
+    return sum(v for k, v in telemetry.metrics_snapshot().items()
+               if k.startswith(name_prefix))
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_taxonomy_retryability_is_a_type_property():
+    assert CylonTransientError("x").retryable is True
+    for exc in (CylonResourceExhausted("x"), CylonPlanError("x"),
+                CylonDataError("x"), CylonTimeoutError("x"),
+                CylonError(Code.Invalid, "x")):
+        assert exc.retryable is False
+    assert is_retryable(CylonTransientError("x"))
+    assert not is_retryable(ValueError("boom"))
+
+
+def test_taxonomy_default_codes_and_subclassing():
+    assert CylonTransientError("x").code == Code.ExecutionError
+    assert CylonResourceExhausted("x").code == Code.OutOfMemory
+    assert CylonPlanError("x").code == Code.Invalid
+    assert CylonPlanError("x", code=Code.NotImplemented).code == \
+        Code.NotImplemented
+    assert CylonDataError("x").code == Code.SerializationError
+    assert CylonTimeoutError("x").code == Code.ExecutionError
+    # every typed error is still a CylonError (catch-all sites keep
+    # working) and carries a Status
+    for exc in (CylonTransientError("x"), CylonDataError("x")):
+        assert isinstance(exc, CylonError)
+        assert exc.status().get_code() == exc.code
+
+
+def test_classify_maps_backend_errors():
+    oom = classify(RuntimeError("RESOURCE_EXHAUSTED: failed to "
+                                "allocate 1GB"))
+    assert isinstance(oom, CylonResourceExhausted)
+    tr = classify(RuntimeError("collective preempted by scheduler"))
+    assert isinstance(tr, CylonTransientError)
+    assert is_retryable(RuntimeError("connection reset by peer"))
+    assert classify(ValueError("plain nonsense")) is None
+    # typed errors pass through unchanged, never re-wrapped
+    e = CylonDataError("bad bytes")
+    assert classify(e) is e
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_grammar():
+    specs = inject.parse_plan(
+        "exchange:2:transient, compile:1:oom,ingest:3+:data,"
+        "pool:4096:oom")
+    assert [(s.site, s.nth, s.persistent, s.kind) for s in specs] == [
+        ("exchange", 2, False, "transient"),
+        ("compile", 1, False, "oom"),
+        ("ingest", 3, True, "data"),
+        ("pool", 4096, False, "oom")]
+    star = inject.parse_plan("exchange:*:transient")[0]
+    assert star.nth == 1 and star.persistent
+    for bad in ("exchange:1", "nowhere:1:transient",
+                "exchange:1:nuke", "exchange:zero:transient",
+                "exchange:0:transient"):
+        with pytest.raises(CylonPlanError):
+            inject.parse_plan(bad)
+
+
+def test_fire_is_deterministic_by_arrival():
+    inject.arm("exchange:2:transient")
+    inject.fire("exchange")                    # arrival 1: no fault
+    with pytest.raises(CylonTransientError, match="arrival 2"):
+        inject.fire("exchange")                # arrival 2: fires
+    inject.fire("exchange")                    # arrival 3: one-shot
+    st = inject.state()
+    assert st["arrivals"]["exchange"] == 3
+    assert len(st["fired"]) == 1
+    assert st["fired"][0]["spec"] == "exchange:2:transient"
+    # re-arming resets the counters: the same plan replays identically
+    inject.arm("exchange:2:transient")
+    inject.fire("exchange")
+    with pytest.raises(CylonTransientError):
+        inject.fire("exchange")
+
+
+def test_persistent_fault_fires_every_arrival():
+    inject.arm("exchange:1+:oom")
+    for _ in range(3):
+        with pytest.raises(CylonResourceExhausted):
+            inject.fire("exchange")
+    inject.disarm()
+    inject.fire("exchange")  # disarmed: no-op
+
+
+def test_pool_site_clamps_budget_instead_of_raising():
+    inject.arm("pool:8192:oom")
+    inject.fire("pool")  # never raises
+    assert inject.budget_clamp() == 8192
+
+    class _Pool:
+        def comm_budget_bytes(self):
+            return 1 << 30
+
+    assert admission.effective_budget(_Pool()) == 8192
+    inject.disarm()
+    assert inject.budget_clamp() is None
+    assert admission.effective_budget(_Pool()) == 1 << 30
+    assert admission.effective_budget(None) is None
+
+
+# ---------------------------------------------------------------------------
+# retry + deadline
+# ---------------------------------------------------------------------------
+
+
+def test_run_retryable_recovers_and_counts(monkeypatch):
+    monkeypatch.setenv("CYLON_RETRY_BACKOFF_S", "0.0")
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise CylonTransientError("flaky stage")
+        return "ok"
+
+    before = _counter('cylon_retries_total{site="test_site"}')
+    with telemetry.span("retry.test") as sp:
+        assert retry.run_retryable("test_site", flaky) == "ok"
+    assert calls["n"] == 3
+    assert _counter('cylon_retries_total{site="test_site"}') \
+        - before == 2
+    # the enclosing span carries the retries attr ([RETRY×n] feed)
+    assert sp.attrs["retries"] == 2
+
+
+def test_run_retryable_accumulates_retries_attr(monkeypatch):
+    """Two retried stages under ONE enclosing span must SUM their
+    retries attr, so [RETRY×n] agrees with cylon_retries_total."""
+    monkeypatch.setenv("CYLON_RETRY_BACKOFF_S", "0.0")
+
+    def flaky_once():
+        state = {"failed": False}
+
+        def fn():
+            if not state["failed"]:
+                state["failed"] = True
+                raise CylonTransientError("first attempt dies")
+            return "ok"
+
+        return fn
+
+    with telemetry.span("retry.accumulate") as sp:
+        retry.run_retryable("test_site", flaky_once())
+        retry.run_retryable("test_site", flaky_once())
+    assert sp.attrs["retries"] == 2
+
+
+def test_run_retryable_nonretryable_raises_immediately(monkeypatch):
+    monkeypatch.setenv("CYLON_RETRY_BACKOFF_S", "0.0")
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise CylonDataError("bad bytes")
+
+    with pytest.raises(CylonDataError):
+        retry.run_retryable("test_site", fatal)
+    assert calls["n"] == 1
+
+
+def test_run_retryable_exhausts_budget(monkeypatch):
+    monkeypatch.setenv("CYLON_RETRY_BACKOFF_S", "0.0")
+    monkeypatch.setenv("CYLON_RETRY_MAX", "4")
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise CylonTransientError("never recovers")
+
+    with pytest.raises(CylonTransientError):
+        retry.run_retryable("test_site", always)
+    assert calls["n"] == 4
+
+
+def test_run_retryable_maps_backend_errors(monkeypatch):
+    monkeypatch.setenv("CYLON_RETRY_BACKOFF_S", "0.0")
+
+    def oom():
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    with pytest.raises(CylonResourceExhausted):
+        retry.run_retryable("test_site", oom)
+
+
+def test_query_deadline_scopes_and_raises():
+    assert retry.remaining_s() is None
+    retry.check_deadline()  # no deadline: no-op
+    with retry.query_deadline(seconds=60):
+        assert 0 < retry.remaining_s() <= 60
+        # nesting keeps the TIGHTER budget
+        with retry.query_deadline(seconds=3600):
+            assert retry.remaining_s() <= 60
+        with retry.query_deadline(seconds=0.0):
+            with pytest.raises(CylonTimeoutError,
+                               match="deadline exceeded"):
+                retry.check_deadline("unit")
+    assert retry.remaining_s() is None
+
+
+def test_executor_enforces_env_deadline(dist_ctx, tmp_path,
+                                        monkeypatch):
+    """A ~zero CYLON_QUERY_DEADLINE_S times the query out with the
+    typed error and leaves a crash dump (analyzed path: the raise
+    crosses the plan.query root span)."""
+    monkeypatch.setenv("CYLON_QUERY_DEADLINE_S", "0.000001")
+    monkeypatch.setenv("CYLON_FLIGHT_DIR", str(tmp_path))
+    left, right = _table(dist_ctx, seed=1), _table(dist_ctx, seed=2)
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    with pytest.raises(CylonTimeoutError):
+        pipe.execute(analyze=True)
+    dumps = glob.glob(str(tmp_path / "*.json"))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert doc["root_label"] == "plan.query"
+
+
+# ---------------------------------------------------------------------------
+# admission controller
+# ---------------------------------------------------------------------------
+
+
+def _nodes_and_est(pipe):
+    from cylon_tpu.plan import ir
+    from cylon_tpu.plan.report import preflight_estimates
+
+    nodes = list(ir.walk(pipe._node))
+    return nodes, preflight_estimates(pipe._node)
+
+
+def test_admission_admits_without_budget(dist_ctx):
+    left, right = _table(dist_ctx, seed=1), _table(dist_ctx, seed=2)
+    nodes, est = _nodes_and_est(
+        plan.scan(left).join(plan.scan(right), on="k"))
+    d = admission.decide(nodes, est, None, 4)
+    assert d.action == "admit" and not d.degrade_blocks
+
+
+def test_admission_sheds_far_over_budget(dist_ctx):
+    left, right = _table(dist_ctx, n=4096, seed=1), \
+        _table(dist_ctx, n=4096, seed=2)
+    nodes, est = _nodes_and_est(
+        plan.scan(left).join(plan.scan(right), on="k"))
+    d = admission.decide(nodes, est, 64, 4)
+    assert d.action == "shed"
+    assert "Join" in d.worst_node
+    with pytest.raises(CylonResourceExhausted,
+                       match="shed by admission controller"):
+        admission.enforce(d)
+
+
+def test_admission_degrades_local_join(local_ctx):
+    """A world-1 join over budget (but under the shed factor) degrades
+    to the blocked path with a sized probe block."""
+    left, right = _table(local_ctx, n=4096, seed=1), \
+        _table(local_ctx, n=4096, seed=2)
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    nodes, est = _nodes_and_est(pipe)
+    join_node = pipe._node
+    budget = est[id(join_node)]["bytes"] // 2  # 2x over: degradable
+    d = admission.decide(nodes, est, budget, 1)
+    assert d.action == "degrade"
+    assert d.degrade_blocks[id(join_node)] >= admission.MIN_BLOCK_ROWS
+    admission.enforce(d)  # degrade passes through
+
+
+def test_admission_sheds_degradable_join_beyond_shed_factor(local_ctx):
+    """Even a world-1 (degradable) join sheds past the shed factor:
+    the blocked path bounds the WORKING SET, but the estimate is the
+    OUTPUT size, which degrade would still materialize in full."""
+    left, right = _table(local_ctx, n=4096, seed=1), \
+        _table(local_ctx, n=4096, seed=2)
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    nodes, est = _nodes_and_est(pipe)
+    tiny = est[id(pipe._node)]["bytes"] // 100   # 100x over
+    d = admission.decide(nodes, est, tiny, 1)
+    assert d.action == "shed"
+    assert not d.degrade_blocks
+
+
+def test_admission_distributed_over_budget_admits_with_warning(
+        dist_ctx):
+    """world>1 has no chunked join lowering: moderately over budget
+    admits (the exchange bounds its own buffers), far over sheds."""
+    left, right = _table(dist_ctx, n=4096, seed=1), \
+        _table(dist_ctx, n=4096, seed=2)
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    nodes, est = _nodes_and_est(pipe)
+    budget = est[id(pipe._node)]["bytes"] // 2
+    d = admission.decide(nodes, est, budget, 4)
+    assert d.action == "admit"
+    assert "over budget" in d.reason
+
+
+def test_executor_shed_records_decision(dist_ctx):
+    inject.arm("pool:1024:oom")
+    left, right = _table(dist_ctx, n=4096, seed=1), \
+        _table(dist_ctx, n=4096, seed=2)
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    before = _counter('cylon_admission_total{decision="shed"}')
+    with pytest.raises(CylonResourceExhausted):
+        pipe.execute()
+    inject.disarm()
+    assert _counter('cylon_admission_total{decision="shed"}') \
+        - before == 1
+    last = flight.admissions()[-1]
+    assert last["action"] == "shed"
+    assert last["budget"] == 1024
+
+
+def test_executor_degrade_matches_clean_result(local_ctx):
+    """Acceptance: the degraded (blocked/chunked) join returns the same
+    rows as the clean join, the decision is recorded, and nothing
+    leaks."""
+    import gc
+
+    left, right = _table(local_ctx, n=4096, seed=5), \
+        _table(local_ctx, n=4096, seed=6)
+    clean = plan.scan(left).join(plan.scan(right), on="k").execute()
+    clean_d = clean.to_pydict()
+    inject.arm("pool:65536:oom")
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    degraded = pipe.execute(analyze=True)
+    inject.disarm()
+    rep = pipe.last_report
+    assert rep.admission["action"] == "degrade"
+    assert "-- admission: degrade" in rep.render()
+    got = degraded.to_pydict()
+    for k in clean_d:
+        assert np.allclose(np.sort(np.asarray(clean_d[k])),
+                           np.sort(np.asarray(got[k])),
+                           rtol=1e-5, atol=1e-6)
+    # the degraded join's span carries the blocked-mode attrs
+    blocked = [s for s in rep.span.walk()
+               if s.attrs.get("mode") == "blocked"]
+    assert blocked and blocked[0].attrs["probe_block_rows"] >= \
+        admission.MIN_BLOCK_ROWS
+    # zero leaks on the degrade path: both results retire on release
+    before_drop = ledger.leak_count()
+    del degraded, clean
+    gc.collect()
+    assert ledger.leak_count() == before_drop - 2
+    assert flight.admissions()[-1]["action"] == "degrade"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end retry through the engine
+# ---------------------------------------------------------------------------
+
+
+def test_injected_exchange_fault_retries_to_success(dist_ctx,
+                                                    monkeypatch):
+    """Acceptance: a transient exchange fault is retried to success —
+    counter up, [RETRY×n] in EXPLAIN ANALYZE, honest result."""
+    monkeypatch.setenv("CYLON_RETRY_BACKOFF_S", "0.001")
+    left, right = _table(dist_ctx, n=2048, seed=11), \
+        _table(dist_ctx, n=2048, seed=12)
+    clean = plan.scan(left).join(plan.scan(right), on="k").execute()
+    clean_rows = clean.row_count
+    inject.arm("exchange:1:transient")
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    before = _counter('cylon_retries_total{site="exchange"}')
+    txt = pipe.explain(analyze=True)
+    inject.disarm()
+    assert _counter('cylon_retries_total{site="exchange"}') \
+        - before >= 1
+    assert "[RETRY" in txt, txt
+    rep = pipe.last_report
+    join_nodes = [m for m in _walk_measures(rep.root)
+                  if m.kind == "join"]
+    assert sum(m.retries for m in join_nodes) >= 1
+    assert rep.to_dict()["plan"]  # retries ride to_dict too
+    result = pipe.execute()
+    assert result.row_count == clean_rows
+
+
+def _walk_measures(m):
+    yield m
+    for c in m.children:
+        yield from _walk_measures(c)
+
+
+def test_persistent_fault_fails_typed_with_dump(dist_ctx, tmp_path,
+                                                monkeypatch):
+    """Acceptance: a persistent exchange fault exhausts retries and
+    surfaces TYPED, with a crash dump whose faults section names the
+    site."""
+    monkeypatch.setenv("CYLON_RETRY_BACKOFF_S", "0.001")
+    monkeypatch.setenv("CYLON_FLIGHT_DIR", str(tmp_path))
+    left, right = _table(dist_ctx, n=1024, seed=21), \
+        _table(dist_ctx, n=1024, seed=22)
+    inject.arm("exchange:1+:transient")
+    pipe = plan.scan(left).join(plan.scan(right), on="k")
+    with pytest.raises(CylonTransientError,
+                       match="injected transient fault at exchange"):
+        pipe.execute(analyze=True)
+    fault_state = inject.state()
+    inject.disarm()
+    assert len(fault_state["fired"]) == retry.max_attempts()
+    dumps = glob.glob(str(tmp_path / "*.json"))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    faults = doc["sections"]["faults"]
+    assert faults["armed"] == "exchange:1+:transient"
+    assert all(f["site"] == "exchange" for f in faults["fired"])
+    assert any(s["name"].startswith("plan.shuffle")
+               for s in doc["error_path"])
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder dump rotation (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_crash_dump_directory_rotates(local_ctx, tmp_path,
+                                      monkeypatch):
+    """CYLON_FLIGHT_MAX_DUMPS bounds the dump directory: the oldest
+    dumps rotate out, the newest survive."""
+    monkeypatch.setenv("CYLON_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("CYLON_FLIGHT_MAX_DUMPS", "3")
+    for i in range(6):
+        with pytest.raises(ValueError):
+            with telemetry.span(f"rot.probe.{i}"):
+                raise ValueError("x")
+        # distinct mtimes so rotation order is deterministic
+        path = flight.last_dump_path()
+        os.utime(path, (1_000_000 + i, 1_000_000 + i))
+    dumps = sorted(os.listdir(str(tmp_path)))
+    assert len(dumps) == 3, dumps
+    # the three NEWEST survive (names carry the dump sequence)
+    assert all(f"rot.probe.{i}" in " ".join(dumps) for i in (3, 4, 5))
+
+
+def test_admission_ring_is_bounded_and_reset():
+    flight.reset()
+    for i in range(100):
+        flight.record_admission({"action": "admit", "i": i})
+    rec = flight.admissions()
+    assert len(rec) <= flight._ring.maxlen or len(rec) < 100
+    assert rec[-1]["i"] == 99
+    flight.reset()
+    assert flight.admissions() == []
