@@ -526,6 +526,97 @@ def bench_plan_pipeline(ctx, n_rows: int, iters: int) -> dict:
     }
 
 
+def bench_service_pipeline(ctx, n_rows: int, iters: int = 3) -> dict:
+    """The SAME query shape submitted 8× — sequential-eager (the plan
+    cache bypassed, so every run pays host-side optimization) vs
+    submitted through the :class:`QueryService` with a warm plan/
+    fingerprint cache. The artifact records the cache hit count, the
+    total ``cylon_kernel_compile_seconds`` (the compile cost the warm
+    cache amortizes — zero NEW factory builds across the whole warmed
+    service phase), and the
+    mean submit→dispatch wait, so scripts/benchtrend.py tracks the
+    service tier round over round (``service_pipeline.cache_hits`` /
+    ``.speedup``)."""
+    import cylon_tpu as ct
+    from cylon_tpu import plan, telemetry
+    from cylon_tpu.service import QueryService, plancache
+
+    rng = np.random.default_rng(11)
+    left = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n_rows // 4, n_rows).astype(np.int32),
+        "v": rng.normal(size=n_rows).astype(np.float32),
+        "z": rng.integers(0, 50, n_rows).astype(np.int32),
+    })
+    right = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n_rows // 4, n_rows).astype(np.int32),
+        "w": rng.normal(size=n_rows).astype(np.float32),
+    })
+
+    def mk_pipe():
+        return plan.scan(left).join(plan.scan(right), on="k") \
+            .groupby("lt-0", ["rt-4"], ["sum"])
+
+    def snap(prefix):
+        return sum(v for k, v in telemetry.metrics_snapshot().items()
+                   if k.startswith(prefix) and isinstance(v, int))
+
+    def compile_seconds():
+        return sum(
+            v.get("sum", 0.0)
+            for k, v in telemetry.metrics_snapshot().items()
+            if k.startswith("cylon_kernel_compile_seconds")
+            and isinstance(v, dict))
+
+    N = 8
+    # warm the kernel memos once so BOTH sides measure steady state
+    _sync(mk_pipe().execute())
+
+    with plancache.disabled():
+        t0 = time.perf_counter_ns()
+        for _ in range(N):
+            _sync(mk_pipe().execute())
+        seq_s = (time.perf_counter_ns() - t0) / 1e9
+
+    h0 = snap("cylon_plan_cache_hits_total")
+    m0 = snap("cylon_plan_cache_misses_total")
+    c0 = compile_seconds()
+    # builds baseline BEFORE the service runs: the warm-up execute
+    # already built every factory this shape needs, so a correct warm
+    # cache shows zero builds across the WHOLE service phase — and the
+    # snapshot races with nothing (vs. snapshotting "after query 1"
+    # while the worker is already executing query 2)
+    b0 = snap("cylon_kernel_factory_builds_total")
+    svc = QueryService(start=False)
+    t0 = time.perf_counter_ns()
+    tickets = [svc.submit(mk_pipe(), tenant=f"t{i % 2}")
+               for i in range(N)]
+    svc.start()
+    svc.drain(timeout=600)
+    for tk in tickets:
+        _sync(tk.result(timeout=600))
+    svc_s = (time.perf_counter_ns() - t0) / 1e9
+    svc.close()
+
+    builds_delta = snap("cylon_kernel_factory_builds_total") - b0
+    waits = [tk.wait_s for tk in tickets if tk.wait_s is not None]
+    world = max(ctx.get_world_size(), 1)
+    return {
+        "world": world,
+        "queries": N,
+        "sequential_wall_s": _sig(seq_s),
+        "service_wall_s": _sig(svc_s),
+        "speedup": _sig(seq_s / svc_s, 4) if svc_s else 0.0,
+        "cache_hits": snap("cylon_plan_cache_hits_total") - h0,
+        "cache_misses": snap("cylon_plan_cache_misses_total") - m0,
+        "builds_after_first_query": builds_delta,
+        "compile_seconds_total": _sig(compile_seconds(), 4),
+        "compile_seconds_during_service": _sig(
+            compile_seconds() - c0, 4),
+        "mean_wait_s": _sig(sum(waits) / len(waits)) if waits else None,
+        "queries_per_s": _sig(N / svc_s, 4) if svc_s else 0.0,
+    }
+
+
 def bench_pandas_reference(n_rows: int, iters: int = 1) -> dict:
     """Same workload, same host, pandas (the reference's Dask-comparison
     discipline, cpp/src/experiments/dask_run.py — a competitor number
@@ -578,6 +669,8 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
              lambda: bench_q5_pipeline(ctx, n_rows // 2, iters)),
             ("plan_pipeline",
              lambda: bench_plan_pipeline(ctx, n_rows // 2, iters)),
+            ("service_pipeline",
+             lambda: bench_service_pipeline(ctx, n_rows // 4, iters)),
             ("string_join",
              lambda: bench_string_join(ctx, n_rows // 4, iters)),
             ("dist_string_join",

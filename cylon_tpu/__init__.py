@@ -30,6 +30,8 @@ from .parallel.shard import distribute_by_key
 from . import plan
 from .plan import LazyTable, col
 from . import resilience
+from . import service
+from .service import QueryService, QueryTicket
 from .status import (Code, CylonDataError, CylonError, CylonPlanError,
                      CylonResourceExhausted, CylonTimeoutError,
                      CylonTransientError, Status)
@@ -44,7 +46,8 @@ __all__ = [
     "CylonTransientError",
     "DataType", "JoinAlgorithm", "JoinConfig", "JoinType", "Layout",
     "LazyTable", "LocalConfig", "MPIConfig", "MultiHostConfig",
-    "ParquetOptions", "Row", "col", "plan", "resilience",
+    "ParquetOptions", "QueryService", "QueryTicket", "Row", "col",
+    "plan", "resilience", "service",
     "Status", "TPUConfig", "Table", "Type", "concat_tables",
     "distribute_by_key", "distributed_groupby", "distributed_join",
     "distributed_join_ring", "distributed_set_op",

@@ -125,6 +125,30 @@ DEFAULT_CONTRACTS: Tuple[LayerContract, ...] = (
                "the machinery it wraps would cycle the retry seam",
     ),
     LayerContract(
+        name="service-top",
+        scope=("service",),
+        forbid=("",),                 # any intra-package import...
+        allow=("service", "plan", "resilience", "telemetry", "status"),
+        # ...except its own submodules and the seams it schedules
+        # through: plans (optimize/execute/preflight), the admission/
+        # retry machinery, the telemetry leaf and the error taxonomy
+        reason="the service tier is the TOP of the stack: it submits "
+               "plans and records decisions, but must never reach "
+               "device machinery (ops/parallel/data/io) directly — "
+               "execution goes through plan/'s executor seam only",
+    ),
+    LayerContract(
+        name="below-service",
+        scope=("ops", "data", "parallel", "plan", "io", "resilience",
+               "telemetry", "analysis"),
+        forbid=("service",),
+        reason="everything below the service tier must stay importable "
+               "without it; plan/ holds only a late-bound optimize-memo "
+               "hook (lazy.set_plan_memo) that service/ registers — an "
+               "upward import would cycle the scheduler's execution "
+               "seam",
+    ),
+    LayerContract(
         name="analysis-read-only",
         scope=("analysis",),
         forbid=("data", "io", "table_api", "arrow_builder"),

@@ -69,16 +69,19 @@ def _resolve_ctx(plan: ir.PlanNode, ctx):
     return None
 
 
-def _preflight(plan: ir.PlanNode, ctx):
+def _preflight(plan: ir.PlanNode, ctx, est=None):
     """Pre-execution memory check: estimate every node's output bytes
     from schema widths × propagated row estimates and compare against
     the pool's comm budget. Over-budget plans emit ONE ``plan.preflight``
     warning span (attrs: worst node, estimate, budget) and a WARNING
     log line — the observable moment before a potential OOM. Returns
-    (estimates map, budget)."""
+    (estimates map, budget). A pre-computed ``est`` map (the service
+    scheduler estimates at SUBMIT time, keyed by these same node ids)
+    skips the plan walk — the warning span still fires."""
     from .report import preflight_estimates
 
-    est = preflight_estimates(plan)
+    if est is None:
+        est = preflight_estimates(plan)
     pool = getattr(ctx, "memory_pool", None) if ctx is not None else None
     # effective budget = pool comm budget clamped by an armed chaos
     # `pool` fault spec — the [MEM] markers, the warning span AND the
@@ -114,33 +117,35 @@ def _admit(plan: ir.PlanNode, ctx, est, budget):
     world = _world(ctx) if ctx is not None else 1
     decision = _admission.decide(list(ir.walk(plan)), est, budget,
                                  world)
+    # record() also emits the plan.admission marker span for non-admit
+    # decisions — shared with the service scheduler's dispatch path
     _admission.record(decision)
-    if decision.action != "admit":
-        with _span("plan.admission", decision=decision.action,
-                   est_bytes=decision.est_bytes,
-                   budget=decision.budget,
-                   worst_node=decision.worst_node or ""):
-            pass
     _admission.enforce(decision)
     return decision
 
 
-def execute(plan: ir.PlanNode, ctx=None) -> Table:
+def execute(plan: ir.PlanNode, ctx=None, decision=None,
+            est=None) -> Table:
     """Execute a plan; returns the result Table (sharded when the
     context is distributed). ``ctx`` defaults to the first scanned
     table's context. Runs under the per-query deadline
     (``CYLON_QUERY_DEADLINE_S``) and the admission controller — a shed
     query raises :class:`CylonResourceExhausted` before any device
-    work."""
+    work. A pre-made ``decision`` (the service scheduler decides —
+    and records — admission at dispatch time, against the live queue
+    state) skips the internal admission pass but keeps its
+    ``degrade_blocks`` lowering map; a pre-computed ``est`` map rides
+    along so the plan is not re-walked per dispatch."""
     rctx = _resolve_ctx(plan, ctx)
     with _resil.query_deadline():
-        est, budget = _preflight(plan, rctx)
-        decision = _admit(plan, rctx, est, budget)
+        est, budget = _preflight(plan, rctx, est=est)
+        if decision is None:
+            decision = _admit(plan, rctx, est, budget)
         return _Exec(ctx, degrade=decision.degrade_blocks).run(plan)
 
 
-def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None
-                     ) -> Tuple[Table, "object"]:
+def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None,
+                     decision=None, est=None) -> Tuple[Table, "object"]:
     """Execute with per-node measurement; returns (Table, PlanReport).
 
     The whole run nests under one ``plan.query`` span (the report's
@@ -157,8 +162,9 @@ def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None
     with telemetry.collect_phases() as cp:
         with _span("plan.query") as root_span:
             with _resil.query_deadline():
-                est, budget = _preflight(plan, rctx)
-                decision = _admit(plan, rctx, est, budget)
+                est, budget = _preflight(plan, rctx, est=est)
+                if decision is None:
+                    decision = _admit(plan, rctx, est, budget)
                 ex = _Exec(ctx, recorder=_Recorder(cp.labels),
                            degrade=decision.degrade_blocks)
                 result = ex.run(plan)

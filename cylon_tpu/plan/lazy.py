@@ -30,6 +30,27 @@ from .optimizer import PlanStats, optimize as _optimize
 _JOIN_TYPES = ("inner", "left", "right", "outer", "full_outer")
 _AGG_OPS = ("sum", "count", "min", "max", "mean")
 
+# Late-bound optimize memo: the service tier's plan/fingerprint cache
+# (service/plancache.install) registers here so repeated query SHAPES
+# skip re-optimization — in the QueryService AND in plain library-mode
+# collect() loops. A hook instead of an import keeps the layering
+# downward-only (analysis/layering.py `below-service`): plan/ never
+# imports service/. Signature: memo(root, world) -> (root, PlanStats).
+_plan_memo = None
+
+
+def set_plan_memo(memo) -> None:
+    """Register (or clear, with None) the optimize memo hook."""
+    global _plan_memo
+    _plan_memo = memo
+
+
+def _optimize_root(root, world):
+    memo = _plan_memo
+    if memo is not None:
+        return memo(root, world)
+    return _optimize(root, world)
+
 
 def _snapshot(table: Table, table_id=None, inline=None) -> ir.Scan:
     types = [ir.STR_TYPE if c.is_string else str(c.data.dtype)
@@ -66,6 +87,12 @@ class LazyTable:
     @property
     def column_count(self) -> int:
         return self._node.width
+
+    @property
+    def context(self):
+        """The CylonContext this query will run under — the public
+        handle the service scheduler executes with."""
+        return self._ctx
 
     scan = staticmethod(scan)
 
@@ -164,8 +191,11 @@ class LazyTable:
         return copy.deepcopy(self._node)
 
     def optimized(self):
-        """(optimized plan root, PlanStats) — without executing."""
-        return _optimize(self._plan_copy(), self._world())
+        """(optimized plan root, PlanStats) — without executing.
+        Memoized through the plan/fingerprint cache when the service
+        package is loaded (equal-shape plans skip the optimizer; see
+        service/plancache.py)."""
+        return _optimize_root(self._plan_copy(), self._world())
 
     def explain(self, optimize: bool = True, analyze: bool = False) -> str:
         """The plan as text. ``analyze=True`` EXECUTES the query
@@ -192,7 +222,7 @@ class LazyTable:
         root = self._plan_copy()
         stats: Optional[PlanStats] = None
         if optimize:
-            root, stats = _optimize(root, self._world())
+            root, stats = _optimize_root(root, self._world())
         if analyze:
             result, report = _execute_analyzed(root, self._ctx,
                                                stats=stats)

@@ -45,6 +45,7 @@ from ..status import CylonResourceExhausted
 from ..telemetry import flight as _flight
 from ..telemetry import logger as _logger
 from ..telemetry import metrics as _metrics
+from ..telemetry import span as _span
 from . import inject as _inject
 
 DEFAULT_SHED_FACTOR = 8.0
@@ -168,12 +169,18 @@ def decide(nodes: List[object], est: Dict[int, dict],
                            f"warning")
 
 
-def record(decision: Decision) -> Decision:
-    """Publish one decision (counter + log + flight admission ring);
-    returns it for chaining."""
+def record(decision: Decision, tenant: Optional[str] = None
+           ) -> Decision:
+    """Publish one decision (counter + log + flight admission ring +
+    the ``plan.admission`` marker span for non-admit decisions);
+    returns it for chaining. ``tenant`` (the service scheduler's
+    multi-tenant label) rides the admission-ring entry — a shed
+    query's forensic record says WHOSE query was shed."""
     _metrics.REGISTRY.counter("cylon_admission_total",
                               {"decision": decision.action}).inc()
     doc = decision.to_dict()
+    if tenant is not None:
+        doc["tenant"] = tenant
     _flight.record_admission(doc)
     if decision.action == "admit":
         _logger.debug("admission: %s (%s)", decision.action,
@@ -183,6 +190,14 @@ def record(decision: Decision) -> Decision:
                         "budget %s B)", decision.action,
                         decision.reason, decision.worst_node,
                         decision.est_bytes, decision.budget)
+        # the trace-visible marker (docs/telemetry.md): every non-admit
+        # decision — executor-internal OR service-dispatch — emits one
+        # plan.admission span before execution (or the shed raise)
+        with _span("plan.admission", decision=decision.action,
+                   est_bytes=decision.est_bytes,
+                   budget=decision.budget,
+                   worst_node=decision.worst_node or ""):
+            pass
     return decision
 
 

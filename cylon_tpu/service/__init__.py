@@ -1,0 +1,42 @@
+"""The concurrent query service tier — the TOP of the cylon_tpu stack.
+
+Turns the one-blocking-``collect()``-at-a-time library into a service
+(ROADMAP item 2): many LazyTable queries submitted at once, per-tenant
+fair-share queueing (deficit round-robin), dispatch-time admission
+against the ledger-tracked live HBM, typed backpressure before
+enqueue, and a plan/fingerprint cache so repeated query shapes skip
+optimization and re-hit the compiled-kernel memos.
+
+* ``scheduler`` — :class:`QueryService` / :class:`QueryTicket`: the
+  async submission surface and the single executor worker (device
+  execution stays serialized; host-side optimize/preflight pipelines
+  on the submitters' threads).
+* ``plancache`` — the structural plan fingerprint and the bounded LRU
+  of optimized plans, shared between the service and library mode.
+
+Importing this package wires the plan cache into ``plan.lazy``'s
+late-bound optimize memo (the hook keeps plan/ from importing
+service/ — the ``below-service`` layering contract), so even plain
+``LazyTable.collect()`` loops skip re-optimizing repeated shapes.
+
+Layering (analysis/layering.py ``service-top``): this package imports
+only plan/, resilience/, telemetry/ and status — never device
+machinery (ops/parallel/data/io); execution goes through plan/'s
+executor seam. Nothing below service may import it back.
+
+Full semantics: docs/service.md.
+"""
+from __future__ import annotations
+
+from . import plancache, scheduler
+from .plancache import PlanCache, fingerprint, global_cache
+from .scheduler import QueryService, QueryTicket
+
+# library-mode wiring: LazyTable.optimized()/execute() memoize through
+# the global fingerprint cache from the moment the package imports
+plancache.install()
+
+__all__ = [
+    "PlanCache", "QueryService", "QueryTicket", "fingerprint",
+    "global_cache", "plancache", "scheduler",
+]

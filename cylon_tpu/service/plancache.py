@@ -1,0 +1,288 @@
+"""Plan/fingerprint cache: repeated query shapes skip the optimizer.
+
+A production service absorbing traffic from many users sees the same
+HANDFUL of query shapes over and over — dashboards refresh, API
+endpoints re-issue the same join+aggregate with fresh parameters. The
+optimizer (plan/optimizer.py: four rewrite passes plus, in debug mode,
+the witness verifier) re-derives the same physical plan every time;
+worse, nothing memoizes it, so "millions of users" pay host-side plan
+work per request. This module keys a bounded LRU of OPTIMIZED plans on
+a **structural fingerprint** of the logical IR tree:
+
+* **what the fingerprint covers** — node kinds, column schemas
+  (names, dtypes, widths), join keys/type/algorithm, groupby
+  keys/aggregates, sort keys/order, set-op kind, projection
+  positions, the full filter expression (op + literal), each Scan's
+  hash-placement witness *shape* (positions + dtypes + world — the
+  one Scan fact the optimizer's elision pass keys on), and the world
+  size. Names are included so a hit can never render ANOTHER query's
+  column names in EXPLAIN trees or admission forensics.
+* **what it deliberately excludes** — table IDENTITIES (object ids,
+  registry ids, row contents). Two equal-shape queries over different
+  tables fingerprint identically: positions were bound at
+  construction, so the cached physical plan is correct for BOTH.
+
+Cache entries are stored as **stripped templates**: every Scan's table
+reference and registry id is nulled before insertion, so the cache
+never pins device buffers (the ledger/leak discipline of PR 5 holds).
+A hit deep-copies the template and REBINDS the incoming query's Scan
+tables in walk order (the optimizer never reorders or duplicates
+scans, so the order is stable by construction).
+
+Verification discipline: a cache must never launder an unverified
+plan. Inserts go through ``optimizer.optimize``, whose
+``CYLON_TPU_VERIFY_PLANS=1`` debug assert verifies the plan at insert
+time; hits RE-verify the rebound plan under the same flag, so a
+hand-poisoned (or future-bug-corrupted) entry is rejected with a typed
+:class:`CylonPlanError` — and evicted — instead of silently executing
+an unsound elision.
+
+Metrics: ``cylon_plan_cache_{hits,misses,evictions}_total``. Because a
+hit re-fires the same lowerings, the same ``counted_cache`` kernel
+factories re-hit their memo — the PR-4 profiler's
+``cylon_kernel_compile_seconds`` shows exactly which compilations the
+cache amortizes.
+
+Library-mode wiring: :func:`install` registers :func:`memo_optimize`
+as ``plan.lazy``'s late-bound optimize hook (the same leaf-hook
+pattern as ``metrics.set_factory_fault_hook``) — plan/ never imports
+service/, the ``below-service`` layering contract holds, and even a
+bare ``LazyTable.collect()`` loop skips re-optimization on repeated
+shapes. ``CYLON_PLAN_CACHE_MAX`` bounds the cache (default 64);
+``0`` disables it entirely.
+"""
+from __future__ import annotations
+
+import copy
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from contextlib import contextmanager
+from dataclasses import replace as _dc_replace
+from typing import Optional, Tuple
+
+from ..plan import ir
+from ..plan.optimizer import PlanStats, optimize as _optimize
+from ..plan.verify import check_plan as _check_plan
+from ..telemetry import metrics as _metrics
+
+DEFAULT_CACHE_MAX = 64
+
+FP_VERSION = 1
+
+
+def cache_max() -> int:
+    return _metrics.env_number("CYLON_PLAN_CACHE_MAX", DEFAULT_CACHE_MAX,
+                               lo=0, as_int=True)
+
+
+# ---------------------------------------------------------------------------
+# structural fingerprint
+# ---------------------------------------------------------------------------
+
+
+def _expr_tokens(e) -> tuple:
+    """Canonical token tree for a bound filter expression — positions,
+    operators and literals (type + repr, so ``3`` and ``3.0`` differ),
+    never Python object identity."""
+    if isinstance(e, ir.Cmp):
+        return ("cmp", int(e.pos), str(e.op), type(e.value).__name__,
+                repr(e.value))
+    if isinstance(e, ir.BoolOp):
+        return (str(e.op), _expr_tokens(e.a), _expr_tokens(e.b))
+    if isinstance(e, ir.Not):
+        return ("not", _expr_tokens(e.a))
+    return ("expr", repr(e))  # future Expr kinds: repr is still stable
+
+
+def _node_tokens(n: ir.PlanNode) -> tuple:
+    """Canonical token tree for one plan node + its subtree."""
+    if isinstance(n, ir.Scan):
+        sig = n.witness_sig
+        wit = None if sig is None else (
+            tuple(int(i) for i in sig[0]),
+            tuple(str(d) for d in sig[1]), int(sig[2]))
+        extra: tuple = ("witness", wit, n.width)
+    elif isinstance(n, ir.Project):
+        extra = ("cols", tuple(n.cols))
+    elif isinstance(n, ir.Filter):
+        extra = ("expr", _expr_tokens(n.expr))
+    elif isinstance(n, ir.Shuffle):
+        extra = ("keys", tuple(n.keys))
+    elif isinstance(n, ir.Join):
+        extra = ("on", tuple(n.left_on), tuple(n.right_on),
+                 str(n.how), str(n.algorithm))
+    elif isinstance(n, ir.GroupBy):
+        extra = ("agg", tuple(n.keys), tuple(n.agg_cols), tuple(n.ops))
+    elif isinstance(n, ir.SetOp):
+        extra = ("op", str(n.op))
+    elif isinstance(n, ir.Sort):
+        extra = ("by", tuple(n.by), tuple(bool(a) for a in n.ascending))
+    else:
+        extra = ("args", n.args_repr())
+    # schema (column NAMES) is part of the key: names flow into
+    # EXPLAIN/report renders and admission worst-node forensics, so a
+    # hit must guarantee the cached template's names are the query's
+    # own — two shapes that differ only in names get two entries
+    return (n.kind, tuple(n.schema), tuple(n.types)) + extra + \
+        tuple(_node_tokens(c) for c in n.children)
+
+
+def fingerprint(root: ir.PlanNode, world: int) -> str:
+    """Stable hex fingerprint of a logical plan's STRUCTURE under a
+    given world size. Pure function of the token tree through sha256 —
+    no ``id()``, no Python ``hash()`` (which is seed-randomized for
+    strings), so the same shape fingerprints identically across
+    processes and runs."""
+    doc = ("cylon-plan-fp", FP_VERSION, int(world), _node_tokens(root))
+    return hashlib.sha256(repr(doc).encode("utf-8")).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# the bounded LRU of optimized-plan templates
+# ---------------------------------------------------------------------------
+
+
+def _scans(root: ir.PlanNode):
+    return [n for n in ir.walk(root) if isinstance(n, ir.Scan)]
+
+
+def _strip_template(root: ir.PlanNode) -> ir.PlanNode:
+    """Deep-copy an optimized plan and null every Scan's table handle —
+    a cached entry must never pin device buffers or registry ids."""
+    tmpl = copy.deepcopy(root)
+    for s in _scans(tmpl):
+        s.table = None
+        s.table_id = None
+    return tmpl
+
+
+class PlanCache:
+    """Fingerprint → (optimized-plan template, PlanStats), bounded LRU.
+
+    ``optimize(root, world)`` is the one entry point: a hit rebinds the
+    template's scans to ``root``'s tables (and re-verifies under
+    ``CYLON_TPU_VERIFY_PLANS=1``); a miss runs the real optimizer and
+    inserts a stripped template. Thread-safe — service submitters
+    prepare plans concurrently with the executor worker."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def _counter(self, event: str):
+        return _metrics.REGISTRY.counter(
+            f"cylon_plan_cache_{event}_total")
+
+    def optimize(self, root: ir.PlanNode, world: int
+                 ) -> Tuple[ir.PlanNode, PlanStats]:
+        cap = cache_max()
+        if cap <= 0 or _bypassed():
+            return _optimize(root, world)
+        fp = fingerprint(root, world)
+        with self._lock:
+            hit = self._entries.get(fp)
+            if hit is not None:
+                self._entries.move_to_end(fp)
+        if hit is not None:
+            out = self._rebind(fp, hit, root, world)
+            if out is not None:
+                self._counter("hits").inc()
+                return out
+            # structural mismatch (defensive — the fingerprint covers
+            # scan layout, so this means a corrupted entry): drop it
+            # and fall through to a fresh optimize
+            self.invalidate(fp)
+        self._counter("misses").inc()
+        opt_root, stats = _optimize(root, world)
+        with self._lock:
+            self._entries[fp] = (_strip_template(opt_root), stats)
+            self._entries.move_to_end(fp)
+            while len(self._entries) > cap:
+                self._entries.popitem(last=False)
+                self._counter("evictions").inc()
+        return opt_root, stats
+
+    def invalidate(self, fp: str) -> None:
+        with self._lock:
+            self._entries.pop(fp, None)
+
+    def _rebind(self, fp: str, entry: tuple, root: ir.PlanNode,
+                world: int) -> Optional[Tuple[ir.PlanNode, PlanStats]]:
+        """Instantiate a cached template for ``root``: deep-copy,
+        rebind scan tables in walk order, and (in debug mode) re-run
+        the witness verifier so a poisoned entry is rejected — evicted
+        and raised as :class:`CylonPlanError` — never executed."""
+        tmpl, stats = entry
+        plan = copy.deepcopy(tmpl)
+        dst, src = _scans(plan), _scans(root)
+        if len(dst) != len(src):
+            return None
+        for d, s in zip(dst, src):
+            d.table = s.table
+            d.table_id = s.table_id
+        if os.environ.get("CYLON_TPU_VERIFY_PLANS") == "1":
+            try:
+                _check_plan(plan, world)
+            except Exception:
+                # a cache must never launder an unverified plan: drop
+                # the poisoned entry, then surface the typed error
+                self.invalidate(fp)
+                raise
+        return plan, _dc_replace(stats, notes=list(stats.notes))
+
+
+# the process-global cache the library-mode memo and every
+# QueryService share — one fingerprint space per process
+_global = PlanCache()
+
+# bypass depth (plancache.disabled()): bench baselines measure the
+# uncached optimizer without disturbing the global cache's contents
+_bypass = 0
+_bypass_lock = threading.Lock()
+
+
+def global_cache() -> PlanCache:
+    return _global
+
+
+def _bypassed() -> bool:
+    return _bypass > 0
+
+
+@contextmanager
+def disabled():
+    """Temporarily bypass the cache (hits AND inserts) — the bench's
+    sequential-eager baseline measures the uncached optimizer cost."""
+    global _bypass
+    with _bypass_lock:
+        _bypass += 1
+    try:
+        yield
+    finally:
+        with _bypass_lock:
+            _bypass -= 1
+
+
+def memo_optimize(root: ir.PlanNode, world: int
+                  ) -> Tuple[ir.PlanNode, PlanStats]:
+    """The ``plan.lazy`` optimize hook: route every LazyTable
+    optimization through the global fingerprint cache."""
+    return _global.optimize(root, world)
+
+
+def install() -> None:
+    """Register the global cache as plan/'s late-bound optimize memo
+    (idempotent; called by ``cylon_tpu.service`` at import)."""
+    from ..plan import lazy as _lazy
+
+    _lazy.set_plan_memo(memo_optimize)

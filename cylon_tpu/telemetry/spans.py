@@ -83,6 +83,13 @@ _HBM_ATTRS = os.environ.get("CYLON_HBM_SPAN_ATTRS", "1") != "0"
 _current: ContextVar[Optional["Span"]] = ContextVar(
     "cylon_tpu_current_span", default=None)
 
+# attributes stamped onto every ROOT span opened in this context (the
+# service tier sets tenant/query_id here, so EXPLAIN ANALYZE trees,
+# flight-ring entries and crash dumps all say whose query they were) —
+# root-only keeps attr volume flat however deep the query tree is
+_root_attrs: ContextVar[Optional[dict]] = ContextVar(
+    "cylon_tpu_root_attrs", default=None)
+
 
 @dataclass
 class Span:
@@ -144,6 +151,23 @@ def annotate(**attrs) -> None:
     s = _current.get()
     if s is not None:
         s.attrs.update(attrs)
+
+
+@contextmanager
+def root_attrs(**attrs) -> Iterator[None]:
+    """Stamp ``attrs`` onto every ROOT span opened inside the context
+    (contextvar-scoped, so concurrent submitters/threads never leak
+    labels into each other's queries). Explicit span attrs win on key
+    collision. The service scheduler threads ``tenant``/``query_id``
+    through here — one context manager instead of touching every
+    execute path."""
+    outer = _root_attrs.get()
+    merged = {**outer, **attrs} if outer else dict(attrs)
+    token = _root_attrs.set(merged)
+    try:
+        yield
+    finally:
+        _root_attrs.reset(token)
 
 
 def add_sink(sink: Callable) -> None:
@@ -223,6 +247,10 @@ def span(name: str, seq: Optional[int] = None, **attrs) -> Iterator[Span]:
     ``s.set(rows_out=...)``. Exceptions re-raise after the span records
     ``error=True`` and its elapsed time (the fixed phase() bug)."""
     parent = _current.get()
+    if parent is None:
+        ra = _root_attrs.get()
+        if ra:
+            attrs = {**ra, **attrs}
     s = Span(name, seq, dict(attrs), span_id=next(_span_ids),
              parent_id=parent.span_id if parent is not None else 0)
     s.root_id = parent.root_id if parent is not None else s.span_id

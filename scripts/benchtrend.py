@@ -105,7 +105,9 @@ def flatten_metrics(parsed: Optional[dict]) -> Dict[str, float]:
                             ("gbps_per_chip", "gbps"),
                             ("speedup", "speedup"),
                             ("join_rows_per_s", "join_rows_per_s"),
-                            ("groupby_rows_per_s", "groupby_rows_per_s")):
+                            ("groupby_rows_per_s", "groupby_rows_per_s"),
+                            ("cache_hits", "cache_hits"),
+                            ("queries_per_s", "queries_per_s")):
             v = _num(cfg.get(src))
             if v is not None:
                 out[f"{name}.{suffix}"] = v

@@ -22,6 +22,13 @@ scripts/check.sh after the telemetry smoke gate):
   the clean run.
 * ``deadline``   — a ~zero ``CYLON_QUERY_DEADLINE_S`` surfaces as a
   typed ``CylonTimeoutError`` with a crash dump.
+* ``service``    — the CONCURRENT drill (PR 7): 6 queries across two
+  tenants plus one over-budget query submitted through the
+  ``QueryService`` while a transient exchange fault is armed and the
+  admission budget is chaos-clamped. The faulted query retries to
+  success, the over-budget one is SHED typed (admission ring names
+  its tenant), every other ticket completes with results equal to
+  the sequential baseline, and per-tenant outcome counters balance.
 
 Every scenario asserts ZERO ledger leaks after its results are
 dropped — retry, shed and degrade paths must not strand HBM.
@@ -56,7 +63,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 SCENARIOS = ("compile", "transient", "persistent", "shed", "degrade",
-             "deadline")
+             "deadline", "service")
 
 
 class ChaosFailure(AssertionError):
@@ -120,6 +127,12 @@ def _retries(telemetry) -> int:
     snap = telemetry.metrics_snapshot()
     return sum(v for k, v in snap.items()
                if k.startswith("cylon_retries_total"))
+
+
+def _outcomes(telemetry, tenant: str, outcome: str) -> int:
+    key = (f'cylon_queries_total{{outcome="{outcome}",'
+           f'tenant="{tenant}"}}')
+    return telemetry.metrics_snapshot().get(key, 0)
 
 
 def _leak_check(ledger, held, scenario, seed, plan):
@@ -335,6 +348,83 @@ def run_seed(seed: int, only=None) -> dict:
                seed, None)
         _leak_check(ledger, held, "deadline", seed, None)
         ran["deadline"] = {"dump": dumps[0]}
+
+    # -- service: concurrent submissions, fault + shed among them -----
+    if wants("service"):
+        from cylon_tpu.service import QueryService
+
+        clamp = 256 * 1024          # normal queries ~0.5x, big ~26x
+        nth = 3 + seed % 3          # exchange arrival hit mid-stream
+        fp = f"pool:{clamp}:oom,exchange:{nth}:transient"
+        tenants = ("tenant-a", "tenant-b")
+        tabs = {t: _tables(ct, ctx, n, seed + 10 + i)
+                for i, t in enumerate(tenants)}
+        big_l, big_r = _tables(ct, ctx, 1 << 16, seed + 50)
+        # clean sequential baselines, BEFORE arming (the acceptance
+        # bar: concurrent results bit-match sequential execution)
+        baselines = {t: _pipe(plan, l, r).execute()
+                     for t, (l, r) in tabs.items()}
+        svc = QueryService(start=False)   # paused: dispatch order is a
+        #                                   pure function of submission
+        inject.arm(fp)
+        r0 = _retries(telemetry)
+        ok0 = {t: _outcomes(telemetry, t, "ok") for t in tenants}
+        tickets = []
+        try:
+            for _ in range(3):
+                for t, (l, r) in tabs.items():
+                    tickets.append((t, svc.submit(_pipe(plan, l, r),
+                                                  tenant=t)))
+            big = svc.submit(
+                plan.scan(big_l).join(plan.scan(big_r), on="k"),
+                tenant="tenant-a")
+            svc.drain(timeout=600)
+        finally:
+            inject.disarm()
+            svc.close()
+        _check(_retries(telemetry) > r0,
+               "no retry recorded for the injected exchange fault "
+               "during the service drill", "service", seed, fp)
+        for t, tk in tickets:
+            res = tk.result(timeout=60)
+            _check(tk.outcome == "ok",
+                   f"ticket {tk.query_id} ({t}) outcome "
+                   f"{tk.outcome!r}, wanted ok", "service", seed, fp)
+            _check(_same_result(res, baselines[t]),
+                   f"concurrent result for {t} diverges from the "
+                   f"sequential baseline", "service", seed, fp)
+            del res
+        err_text = None
+        try:
+            big.result(timeout=60)
+        except ct.CylonResourceExhausted as e:
+            err_text = str(e)
+        else:
+            _check(False, "over-budget service query was not shed",
+                   "service", seed, fp)
+        _check("shed by admission controller" in err_text,
+               f"unexpected shed error text: {err_text}", "service",
+               seed, fp)
+        _check(big.outcome == "shed",
+               f"shed ticket outcome {big.outcome!r}", "service",
+               seed, fp)
+        sheds = [d for d in flight.admissions()
+                 if d.get("action") == "shed"]
+        _check(sheds and sheds[-1].get("tenant") == "tenant-a",
+               f"admission ring does not name the shed tenant: "
+               f"{sheds[-1:]}", "service", seed, fp)
+        for t in tenants:
+            got = _outcomes(telemetry, t, "ok") - ok0[t]
+            _check(got == 3,
+                   f"cylon_queries_total{{tenant={t},outcome=ok}} "
+                   f"moved by {got}, wanted 3", "service", seed, fp)
+        n_retried = _retries(telemetry) - r0
+        # drop every result reference (incl. the comparison loop vars)
+        # before the zero-new-leaks assertion
+        del big, tickets, baselines, tabs, big_l, big_r, svc, t, tk, l, r
+        _leak_check(ledger, held, "service", seed, fp)
+        ran["service"] = {"retries": n_retried, "nth": nth,
+                          "shed": sheds[-1]}
 
     del baseline
     gc.collect()

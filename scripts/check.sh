@@ -21,14 +21,26 @@ echo "== telemetry smoke: scripts/smoke_telemetry.py =="
 # stack, metrics, nonzero pool watermark, ledger outstanding set)
 python scripts/smoke_telemetry.py
 
+echo "== service smoke: scripts/smoke_service.py =="
+# the concurrent query service: 8 equal-shape queries over two tenants
+# must return results bit-identical to sequential execution with >= 7
+# plan-cache hits, zero kernel-factory builds after the first query
+# (cached plans re-verified by plan/verify.py on every hit), per-tenant
+# cylon_queries_total/queue-depth series in the Prometheus dump, and
+# zero ledger leaks
+python scripts/smoke_service.py
+
 echo "== chaos drill: scripts/chaos.py --seeds 3 =="
 # seeded fault plans through the bench pipeline: transient faults must
 # retry to success ([RETRY] in EXPLAIN ANALYZE), persistent faults must
 # fail TYPED with a parseable crash dump naming the fault site, an
 # over-budget query must be shed or degraded by the admission
-# controller, a zero deadline must time out typed — all deterministic
-# per seed, zero ledger leaks on every path; failures print the fault
-# plan + seed for one-command replay
+# controller, a zero deadline must time out typed, and the CONCURRENT
+# service drill (queries across two tenants with an injected exchange
+# fault + one over-budget query) must retry/shed without disturbing the
+# other queries' results — all deterministic per seed, zero ledger
+# leaks on every path; failures print the fault plan + seed for
+# one-command replay
 python scripts/chaos.py --seeds 3
 
 echo "== bench trend: scripts/benchtrend.py --check =="

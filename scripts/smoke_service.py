@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Service-layer smoke gate (wired into scripts/check.sh).
+
+Drives the concurrent query service end to end on the virtual CPU
+mesh — two tenants, the same query shape submitted 8× — and verifies
+the acceptance bar of the service tier:
+
+* **plan cache proven live** — ``cylon_plan_cache_hits_total`` moves
+  by ≥ 7 for the 8 equal-shape submissions, and the kernel-factory
+  build counter does not move AFTER the first query (the same
+  lowerings re-hit the same ``counted_cache`` memos, so the cache
+  amortizes both optimization AND compilation). ``CYLON_TPU_VERIFY_
+  PLANS=1`` is forced, so every cache HIT re-runs the witness
+  verifier — cached plans still pass plan/verify.py.
+* **results are bit-identical to sequential execution** — each
+  ticket's table equals the same pipeline run directly.
+* **per-tenant accounting** — the Prometheus dump carries
+  ``cylon_queries_total{outcome="ok",tenant=...}`` for both tenants,
+  the ``cylon_service_wait_seconds`` histogram counted every query,
+  the plan-cache counters render, and the per-tenant queue-depth
+  gauges are back to zero.
+* **tenant forensics** — an ``analyze=True`` submission's root span
+  carries the tenant label (EXPLAIN ANALYZE / flight ring / crash
+  dumps all say whose query it was).
+* **nothing leaks** — the ledger reports zero non-borrowed entries
+  once results are dropped.
+
+Exit 0 on success; any failure prints the offending artifact and
+exits non-zero, failing the gate.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+# cached plans must still pass witness verification on every hit
+os.environ["CYLON_TPU_VERIFY_PLANS"] = "1"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_QUERIES = 8
+TENANTS = ("tenant-a", "tenant-b")
+
+
+def fail(msg: str) -> None:
+    print(f"service smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    import gc
+
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu import plan, telemetry
+    from cylon_tpu.service import QueryService
+    from cylon_tpu.telemetry import ledger
+
+    ctx = ct.CylonContext.InitDistributed(ct.TPUConfig(world_size=4))
+    rng = np.random.default_rng(7)
+    n = 4096
+
+    def tables(seed):
+        r = np.random.default_rng(seed)
+        left = ct.Table.from_pydict(ctx, {
+            "k": r.integers(0, n // 4, n).astype(np.int32),
+            "v": r.normal(size=n).astype(np.float32),
+            "z": r.integers(0, 50, n).astype(np.int32)})
+        right = ct.Table.from_pydict(ctx, {
+            "k": r.integers(0, n // 4, n).astype(np.int32),
+            "w": r.normal(size=n).astype(np.float32)})
+        return left, right
+
+    tabs = {t: tables(100 + i) for i, t in enumerate(TENANTS)}
+
+    def pipe(t):
+        left, right = tabs[t]
+        return plan.scan(left).join(plan.scan(right), on="k") \
+            .groupby("lt-2", ["rt-4"], ["sum"])
+
+    def rows(table):
+        d = table.to_pydict()
+        ks = sorted(d)
+        return ks, sorted(zip(*(np.asarray(d[k]).tolist()
+                                for k in ks)))
+
+    def counter_sum(prefix):
+        return sum(v for k, v in telemetry.metrics_snapshot().items()
+                   if k.startswith(prefix) and isinstance(v, int))
+
+    # sequential reference results, one per tenant (also warms the
+    # kernel memos AND inserts the shape into the plan cache)
+    seq = {t: rows(pipe(t).execute()) for t in TENANTS}
+
+    hits0 = counter_sum("cylon_plan_cache_hits_total")
+    svc = QueryService()
+    # first query ALONE — wait for it, then snapshot the factory-build
+    # counter while the worker is provably idle (queue empty). Taking
+    # the baseline with later queries already executing would let a
+    # cache regression's rebuilds hide inside it.
+    first_tenant = TENANTS[0]
+    first = svc.submit(pipe(first_tenant), tenant=first_tenant,
+                       analyze=True)
+    first.result(timeout=600)
+    builds_after_first = counter_sum("cylon_kernel_factory_builds_total")
+    tickets = [(first_tenant, first)]
+    for i in range(1, N_QUERIES):
+        t = TENANTS[i % 2]
+        tickets.append((t, svc.submit(pipe(t), tenant=t)))
+    svc.drain(timeout=600)
+
+    # -- results bit-match sequential execution -----------------------
+    for t, tk in tickets:
+        if tk.outcome != "ok":
+            fail(f"ticket {tk.query_id} ({t}) outcome {tk.outcome!r}: "
+                 f"{tk}")
+        got = rows(tk.result(timeout=60))
+        if got != seq[t]:
+            fail(f"service result for {t} diverges from sequential "
+                 f"execution")
+    svc.close()
+
+    # -- plan cache proven live ---------------------------------------
+    hits = counter_sum("cylon_plan_cache_hits_total") - hits0
+    if hits < N_QUERIES - 1:
+        fail(f"plan cache hits {hits} < {N_QUERIES - 1} for "
+             f"{N_QUERIES} equal-shape submissions")
+    builds_delta = counter_sum("cylon_kernel_factory_builds_total") \
+        - builds_after_first
+    if builds_delta != 0:
+        fail(f"{builds_delta} kernel factory build(s) AFTER the first "
+             f"service query — the warm cache is not amortizing "
+             f"compilation")
+
+    # -- tenant label on the analyzed query's root span ---------------
+    rep = first.report()
+    if rep is None:
+        fail("analyze=True submission produced no PlanReport")
+    if rep.span.attrs.get("tenant") != first_tenant:
+        fail(f"EXPLAIN ANALYZE root span lacks the tenant label: "
+             f"{rep.span.attrs}")
+
+    # -- Prometheus dump: per-tenant series wired ---------------------
+    prom = telemetry.prometheus_text()
+    for t in TENANTS:
+        want = 4  # N_QUERIES split evenly
+        line = [l for l in prom.splitlines()
+                if l.startswith("cylon_queries_total")
+                and f'tenant="{t}"' in l and 'outcome="ok"' in l]
+        if not line:
+            fail(f"cylon_queries_total{{tenant={t},outcome=ok}} "
+                 f"missing from the Prometheus dump")
+        if float(line[0].split()[-1]) != want:
+            fail(f"per-tenant ok counter off: {line[0]} (want {want})")
+        depth = [l for l in prom.splitlines()
+                 if l.startswith("cylon_service_queue_depth")
+                 and f'tenant="{t}"' in l]
+        if not depth or float(depth[0].split()[-1]) != 0:
+            fail(f"queue depth gauge not drained: {depth}")
+    for series in ("cylon_service_wait_seconds_bucket",
+                   "cylon_plan_cache_hits_total",
+                   "cylon_plan_cache_misses_total"):
+        if series not in prom:
+            fail(f"{series} missing from the Prometheus dump")
+    wait_count = [l for l in prom.splitlines()
+                  if l.startswith("cylon_service_wait_seconds_count")]
+    if not wait_count or float(wait_count[0].split()[-1]) < N_QUERIES:
+        fail(f"wait histogram counted fewer than {N_QUERIES} "
+             f"queries: {wait_count}")
+
+    # -- nothing leaks ------------------------------------------------
+    mean_wait = sum(w.wait_s for _t, w in tickets) / len(tickets)
+    del tickets, first, rep, seq, tk  # tk: the comparison loop var
+    gc.collect()
+    if ledger.leak_count() != 0:
+        fail(f"ledger leaks after dropping service results: "
+             f"{ledger.outstanding(include_borrowed=False)}")
+
+    print(f"service smoke: OK — {N_QUERIES} queries over "
+          f"{len(TENANTS)} tenants, {hits} plan-cache hits, "
+          f"0 extra kernel builds after query 1, "
+          f"mean wait {mean_wait * 1e3:.2f} ms, zero leaks")
+
+
+if __name__ == "__main__":
+    main()

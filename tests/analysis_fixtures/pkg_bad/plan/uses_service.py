@@ -1,0 +1,4 @@
+"""Seeded below-service violation: a lower layer importing the service
+tier back (layering/below-service) — the upward import the late-bound
+optimize-memo hook exists to avoid."""
+from ..service import scheduler  # VIOLATION: plan/ must not reach UP

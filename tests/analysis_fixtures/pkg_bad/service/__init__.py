@@ -1,0 +1,4 @@
+"""Seeded service-top violation: the service tier reaching PAST the
+plan seam into device machinery (layering/service-top)."""
+from ..plan import ir            # allowed: plans are the service's seam
+from ..ops import bad_kernel     # VIOLATION: device kernels bypass plan/

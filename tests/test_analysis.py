@@ -46,6 +46,10 @@ def test_layering_fixture_reports_exactly_seeded():
         ("plan/bad_lowering.py", 3, "layering/plan-no-ops"),
         ("plan/bad_lowering.py", 4, "layering/plan-no-ops"),
         ("data/column.py", 3, "layering/data-below-ops"),
+        # the service tier (PR 7): reaching past the plan seam into
+        # device machinery, and a lower layer importing service back
+        ("service/__init__.py", 4, "layering/service-top"),
+        ("plan/uses_service.py", 4, "layering/below-service"),
     }, res.format_text()
     # the seeded suppression on data/column.py:7 counted as suppressed
     assert res.suppressed == 1
@@ -295,7 +299,7 @@ def test_json_schema_stable():
     assert doc["version"] == SCHEMA_VERSION == 1
     assert doc["ok"] is False
     assert doc["checkers"] == ["layering"]
-    assert doc["counts"] == {"layering": 10}
+    assert doc["counts"] == {"layering": 12}
     assert doc["suppressed"] == 1
     for f in doc["findings"]:
         assert set(f) == {"rule", "path", "line", "col", "message"}

@@ -44,9 +44,25 @@ def test_full_suite_small(local_ctx):
     suite = res["detail"]["suite"]
     for name in ("groupby_agg", "global_sort", "set_union", "q5_pipeline",
                  "string_join", "dist_string_join", "dist_sort", "dist_union",
-                 "shuffle_wide", "hbm_blocked_join", "pandas_reference"):
+                 "shuffle_wide", "hbm_blocked_join", "pandas_reference",
+                 "service_pipeline"):
         assert name in suite, f"missing config {name}"
         assert "error" not in suite[name], (name, suite[name])
+    json.dumps(res)
+
+
+def test_service_pipeline_records_cache_amortization(local_ctx):
+    """The service_pipeline config proves the plan cache live in the
+    artifact: >= 7 of 8 equal-shape submissions hit, zero kernel
+    builds after the first query, and the mean wait rides along for
+    the benchtrend trajectory."""
+    ctx = bench._mk_ctx()
+    res = bench.bench_service_pipeline(ctx, 1 << 10, iters=1)
+    assert res["queries"] == 8
+    assert res["cache_hits"] >= 7
+    assert res["builds_after_first_query"] == 0
+    assert res["mean_wait_s"] is not None and res["mean_wait_s"] >= 0
+    assert res["service_wall_s"] > 0 and res["sequential_wall_s"] > 0
     json.dumps(res)
 
 
