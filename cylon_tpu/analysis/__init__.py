@@ -1,6 +1,6 @@
 """cylon_tpu.analysis — pluggable static-analysis suite.
 
-Five checker families guard the invariants the paper's *local kernel +
+Nine checker families guard the invariants the paper's *local kernel +
 shuffle + local kernel* decomposition rests on (SURVEY §1), each
 registered in `core.CHECKERS` and runnable from one entry point:
 
@@ -31,7 +31,19 @@ registered in `core.CHECKERS` and runnable from one entry point:
                       neither re-raise nor report (log call /
                       ``error=True`` span attr) are findings — a
                       fault dying in one never reaches the
-                      resilience layer's retry or flight recorder.
+                      resilience layer's retry or flight recorder;
+* ``concurrency``   — thread-domain race detector over the service
+                      tier: shared state written across the worker/
+                      submitter/finalizer/hook domains must follow
+                      the per-attribute lock discipline, no blocking
+                      call may hold a lock, thread-entry code must
+                      re-stamp the contextvars it reads, and GC
+                      finalizers must never touch non-reentrant
+                      locks or jax;
+* ``envknobs``      — every ``CYLON_*`` environment read routes
+                      through the declared knob registry
+                      (telemetry/knobs.py) and every declared knob
+                      appears in the generated docs table.
 
 Run ``python -m cylon_tpu.analysis`` (see ``--help``); wired into
 ``scripts/check.sh`` ahead of tier-1. Rule catalog, suppression syntax
@@ -50,6 +62,8 @@ from . import witness as _witness            # noqa: F401,E402
 from . import spancov as _spancov            # noqa: F401,E402
 from . import ledgercov as _ledgercov        # noqa: F401,E402
 from . import errors as _errors              # noqa: F401,E402
+from . import concurrency as _concurrency    # noqa: F401,E402
+from . import envknobs as _envknobs          # noqa: F401,E402
 
 __all__ = ["AnalysisContext", "CHECKERS", "Finding", "RunResult",
            "SCHEMA_VERSION", "register", "run_checkers", "to_json_text"]

@@ -82,7 +82,8 @@ def main(argv=None) -> int:
         # (collectives/witness) are about the REAL package's kernels
         # and optimizer — run only the file-scanning families
         families = ["layering", "hostsync", "span-coverage",
-                    "ledger-coverage", "errors"]
+                    "ledger-coverage", "errors", "concurrency",
+                    "envknobs"]
 
     ctx = AnalysisContext(root, options)
     try:

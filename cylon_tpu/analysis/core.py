@@ -193,6 +193,192 @@ def resolve_import(module: Optional[str], level: int, importer_pkg: str,
 
 
 # ---------------------------------------------------------------------------
+# shared call-graph machinery (hoisted from the hostsync pass in PR 8 so
+# the concurrency checker reuses the SAME transitive-closure semantics —
+# two checkers must never disagree about what a call statement targets)
+# ---------------------------------------------------------------------------
+
+
+def attr_chain(node: ast.AST):
+    """('jax','lax','psum') for ``jax.lax.psum``; ('f',) for bare
+    names; None when the chain does not bottom out in a Name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+class ModuleIndex:
+    """Per-file symbol tables for closure passes.
+
+    ``functions`` maps module-level def names to their AST;
+    ``methods`` maps ``Class.method`` qualnames (one level — the
+    repo's universal shape); ``objects`` maps module-level
+    ``NAME = Cls(...)`` singletons to their class so
+    ``alias.OBJ.method()`` call chains resolve (the metrics REGISTRY
+    pattern); ``mod_aliases``/``fn_imports`` resolve intra-package
+    ``alias.fn(...)`` and ``from ..m import f`` calls."""
+
+    def __init__(self, sf: SourceFile, modname: str, package: str):
+        self.sf = sf
+        self.modname = modname
+        self.functions: Dict[str, ast.AST] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.methods: Dict[str, ast.AST] = {}     # "Cls.m" -> def node
+        self.objects: Dict[str, tuple] = {}       # name -> (mod, Cls)
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.methods[f"{node.name}.{sub.name}"] = sub
+        # local alias -> package-relative module path, for call
+        # resolution of `_join.join_plan_keys(...)`
+        self.mod_aliases: Dict[str, str] = {}
+        # local name -> (module path, name) from
+        # `from ..ops.join import gather_columns as _gather`
+        self.fn_imports: Dict[str, tuple] = {}
+        pkg = importer_package(sf.rel, modname)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    target = resolve_import(a.name, 0, pkg, package)
+                    if target:  # intra-package, below the root
+                        self.mod_aliases[a.asname
+                                         or a.name.split(".")[-1]] = target
+            elif isinstance(node, ast.ImportFrom):
+                base = resolve_import(node.module or "", node.level, pkg,
+                                      package)
+                if base is None:
+                    continue
+                for a in node.names:
+                    sub = (base + "." + a.name) if base else a.name
+                    local = a.asname or a.name
+                    # imported name could be a submodule or a function;
+                    # record both interpretations, resolved lazily
+                    self.mod_aliases.setdefault(local, sub)
+                    self.fn_imports[local] = (base, a.name)
+        # module-level singletons: NAME = Cls(...) where Cls is a local
+        # class or an imported one
+        for node in sf.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            chain = attr_chain(node.value.func)
+            if chain is None:
+                continue
+            name = node.targets[0].id
+            if len(chain) == 1 and chain[0] in self.classes:
+                self.objects[name] = (modname, chain[0])
+            elif len(chain) == 1 and chain[0] in self.fn_imports:
+                self.objects[name] = self.fn_imports[chain[0]]
+
+    def lookup(self, qualname: str):
+        """The def node for a module-level function OR a Class.method
+        qualname, or None."""
+        return self.functions.get(qualname) or self.methods.get(qualname)
+
+
+def build_module_index(ctx: AnalysisContext) -> Dict[str, ModuleIndex]:
+    # memoized on the context: hostsync, concurrency and envknobs all
+    # index the same tree in one run, and the walk is the dominant
+    # cost the check.sh 30s budget guards
+    cached = ctx.options.get("_module_index")
+    if cached is None:
+        cached = {ctx.module_name(sf): ModuleIndex(sf,
+                                                   ctx.module_name(sf),
+                                                   ctx.package_name)
+                  for sf in ctx.files()}
+        ctx.options["_module_index"] = cached
+    return cached
+
+
+def called_functions(body: ast.AST, mod: ModuleIndex,
+                     modules: Optional[Dict[str, ModuleIndex]] = None,
+                     self_cls: Optional[str] = None):
+    """(module path, qualname) pairs ``body`` calls, resolved as far as
+    syntax allows: same-module ``fn(...)``, imported ``fn(...)``,
+    intra-package ``alias.fn(...)``, ``self.m(...)`` (when ``self_cls``
+    names the enclosing class), ``Cls(...)`` construction (-> its
+    ``__init__``), module-level singleton ``obj.m(...)``, and — given
+    ``modules`` — the three-deep ``alias.OBJ.m(...)`` form."""
+    out = set()
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if chain is None:
+            continue
+        if len(chain) == 1:
+            name = chain[0]
+            if name in mod.functions:
+                out.add((mod.modname, name))
+            elif name in mod.classes:
+                if f"{name}.__init__" in mod.methods:
+                    out.add((mod.modname, f"{name}.__init__"))
+            elif name in mod.fn_imports:
+                base, fn = mod.fn_imports[name]
+                target = modules.get(base) if modules else None
+                if target is not None and fn in target.classes:
+                    if f"{fn}.__init__" in target.methods:
+                        out.add((base, f"{fn}.__init__"))
+                else:
+                    out.add(mod.fn_imports[name])
+        elif len(chain) == 2:
+            head, meth = chain
+            if head == "self" and self_cls is not None:
+                if f"{self_cls}.{meth}" in mod.methods:
+                    out.add((mod.modname, f"{self_cls}.{meth}"))
+            elif head in mod.objects:
+                omod, ocls = mod.objects[head]
+                out.add((omod, f"{ocls}.{meth}"))
+            elif head in mod.mod_aliases:
+                out.add((mod.mod_aliases[head], meth))
+        elif len(chain) == 3 and modules is not None:
+            alias, obj, meth = chain
+            target = modules.get(mod.mod_aliases.get(alias, ""))
+            if target is not None and obj in target.objects:
+                omod, ocls = target.objects[obj]
+                out.add((omod, f"{ocls}.{meth}"))
+    return out
+
+
+def call_closure(modules: Dict[str, ModuleIndex], seeds: Dict,
+                 package: str) -> Dict:
+    """Transitive closure over the call graph from ``seeds`` — a
+    ``{(mod, qualname): chain description}`` map. Returns the closed
+    map; each discovered callee's description extends its caller's
+    (``root -> mod.callee``), so findings can print the whole chain."""
+    closed = dict(seeds)
+    work = list(seeds)
+    while work:
+        modname, fname = work.pop()
+        mod = modules.get(modname)
+        fn = mod.lookup(fname) if mod is not None else None
+        if fn is None:
+            continue
+        desc = closed[(modname, fname)]
+        self_cls = fname.split(".", 1)[0] if "." in fname else None
+        for callee in called_functions(fn, mod, modules, self_cls):
+            cmod, cfn = callee
+            target = modules.get(cmod)
+            if target is None or target.lookup(cfn) is None:
+                continue
+            if callee not in closed:
+                closed[callee] = f"{desc} -> {cmod or package}.{cfn}"
+                work.append(callee)
+    return closed
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 

@@ -38,8 +38,8 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from .core import (AnalysisContext, Finding, importer_package, register,
-                   resolve_import)
+from .core import (AnalysisContext, Finding, ModuleIndex, attr_chain,
+                   build_module_index, call_closure, register)
 
 # call targets whose function-valued arguments become traced
 _TRACING_CALLS = {
@@ -64,16 +64,9 @@ _SYNC_METHODS = {"item", "tolist"}
 _CAST_BUILTINS = {"float", "int", "bool"}
 
 
-def _attr_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
-    """('jax','lax','psum') for jax.lax.psum; ('f',) for bare names."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return tuple(reversed(parts))
-    return None
+# the attribute-chain resolver now lives in core (attr_chain) — one
+# copy shared with the concurrency checker's call-graph pass
+_attr_chain = attr_chain
 
 
 def _is_jit_decorator(dec: ast.AST) -> bool:
@@ -154,43 +147,9 @@ def _is_staticish(node: ast.AST, static_names: Set[str] = frozenset()
     return False
 
 
-class _Module:
-    """Per-file symbol tables for the closure pass."""
-
-    def __init__(self, sf, modname: str, package: str):
-        self.sf = sf
-        self.modname = modname
-        # module-level (and class-level is irrelevant here) functions
-        self.functions: Dict[str, ast.AST] = {}
-        for node in sf.tree.body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.functions[node.name] = node
-        # local alias -> package-relative module path, for call
-        # resolution of `_join.join_plan_keys(...)`
-        self.mod_aliases: Dict[str, str] = {}
-        # local name -> (module path, function name) from
-        # `from ..ops.join import gather_columns as _gather`
-        self.fn_imports: Dict[str, Tuple[str, str]] = {}
-        pkg = importer_package(sf.rel, modname)
-        for node in ast.walk(sf.tree):
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    target = resolve_import(a.name, 0, pkg, package)
-                    if target:  # intra-package, below the root
-                        self.mod_aliases[a.asname
-                                         or a.name.split(".")[-1]] = target
-            elif isinstance(node, ast.ImportFrom):
-                base = resolve_import(node.module or "", node.level, pkg,
-                                      package)
-                if base is None:
-                    continue
-                for a in node.names:
-                    sub = (base + "." + a.name) if base else a.name
-                    local = a.asname or a.name
-                    # imported name could be a submodule or a function;
-                    # record both interpretations, resolved lazily
-                    self.mod_aliases.setdefault(local, sub)
-                    self.fn_imports[local] = (base, a.name)
+# the per-file symbol tables now live in core (ModuleIndex) — the
+# closure pass shares them with the concurrency checker
+_Module = ModuleIndex
 
 
 def _trace_roots(mod: _Module) -> Set[str]:
@@ -210,28 +169,6 @@ def _trace_roots(mod: _Module) -> Set[str]:
             if inner is not None and len(inner) == 1:
                 roots.add(inner[0])
     return roots
-
-
-def _called_functions(body: ast.AST, mod: _Module
-                      ) -> Set[Tuple[str, str]]:
-    """(module path, function name) pairs this traced body calls —
-    same-module calls plus intra-package `alias.fn(...)` calls."""
-    out: Set[Tuple[str, str]] = set()
-    for node in ast.walk(body):
-        if not isinstance(node, ast.Call):
-            continue
-        chain = _attr_chain(node.func)
-        if chain is None:
-            continue
-        if len(chain) == 1:
-            name = chain[0]
-            if name in mod.functions:
-                out.add((mod.modname, name))
-            elif name in mod.fn_imports:
-                out.add(mod.fn_imports[name])
-        elif len(chain) == 2 and chain[0] in mod.mod_aliases:
-            out.add((mod.mod_aliases[chain[0]], chain[1]))
-    return out
 
 
 def _scan_body(fn: ast.AST, mod: _Module, chain_desc: str
@@ -277,39 +214,24 @@ def _scan_body(fn: ast.AST, mod: _Module, chain_desc: str
 @register("hostsync")
 def check_hostsync(ctx: AnalysisContext) -> List[Finding]:
     package = ctx.package_name
-    modules: Dict[str, _Module] = {}
-    for sf in ctx.files():
-        modname = ctx.module_name(sf)
-        modules[modname] = _Module(sf, modname, package)
+    modules = build_module_index(ctx)
 
     # seed with direct trace roots, then close over the call graph
-    traced: Dict[Tuple[str, str], str] = {}   # (mod, fn) -> chain desc
-    work: List[Tuple[str, str]] = []
+    # (core.call_closure — the machinery shared with the concurrency
+    # checker's thread-domain reachability)
+    seeds: Dict[Tuple[str, str], str] = {}
     for modname, mod in modules.items():
         for name in _trace_roots(mod):
             if name in mod.functions:
-                key = (modname, name)
-                traced[key] = name
-                work.append(key)
-    while work:
-        modname, fname = work.pop()
-        mod = modules.get(modname)
-        if mod is None or fname not in mod.functions:
-            continue
-        desc = traced[(modname, fname)]
-        for callee in _called_functions(mod.functions[fname], mod):
-            cmod, cfn = callee
-            target = modules.get(cmod)
-            if target is None or cfn not in target.functions:
-                continue
-            if callee not in traced:
-                traced[callee] = f"{desc} -> {cmod or package}.{cfn}"
-                work.append(callee)
+                seeds[(modname, name)] = name
+    traced = call_closure(modules, seeds, package)
 
     findings: List[Finding] = []
     for (modname, fname), desc in sorted(traced.items()):
         mod = modules[modname]
-        findings.extend(_scan_body(mod.functions[fname], mod, desc))
+        fn = mod.lookup(fname)
+        if fn is not None:
+            findings.extend(_scan_body(fn, mod, desc))
 
     # classification summary: every host-transfer call site in the tree
     # is either inside a traced closure (flagged above) or host-side

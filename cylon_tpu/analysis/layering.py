@@ -52,9 +52,15 @@ DEFAULT_CONTRACTS: Tuple[LayerContract, ...] = (
         name="base-leaf",
         scope=("status.py", "dtypes.py", "util.py", "native.py",
                "memory.py"),
-        forbid=("",),  # any intra-package import
+        forbid=("",),  # any intra-package import...
+        allow=("telemetry.knobs",),
+        # ...except the declared knob registry, itself a stdlib-only
+        # leaf (memory.py reads CYLON_HBM_BYTES through it; telemetry
+        # never imports back, so no cycle seed)
         reason="base-layer modules are leaves: everything imports them, "
-               "so any import back into the package is a cycle seed",
+               "so any import back into the package is a cycle seed "
+               "(the stdlib-only knob registry telemetry.knobs is the "
+               "one sanctioned exception)",
     ),
     LayerContract(
         name="telemetry-leaf",

@@ -11,15 +11,23 @@ buffers from the pool, arrow_all_to_all.cpp:234-247).
 """
 from __future__ import annotations
 
-import os
 from typing import Callable, Dict, List, Optional, Tuple
+
+# the knob registry is the one sanctioned telemetry import for base
+# leaves: knobs.py itself imports only the stdlib, and nothing in
+# telemetry imports memory, so no cycle. Note the carve-out is a
+# DEPENDENCY statement, not an import-cost one — binding the submodule
+# still executes the telemetry package __init__ (spans/metrics/etc.),
+# which is fine because cylon_tpu/__init__ pulls all of that on any
+# entry into the package anyway.
+from .telemetry.knobs import default as _knob_default, get as _knob_get
 
 # HBM per chip when the runtime hides memory_stats (tunneled backends —
 # the axon platform returns None): v5e carries 16 GiB. Overridable via
 # CYLON_HBM_BYTES. Without this fallback the >HBM routing guards
 # (join_blocked auto-engage, shuffle comm budget) silently disarm and a
 # beyond-memory join OOMs instead of chunking.
-DEFAULT_TPU_HBM_BYTES = 16 * (1 << 30)
+DEFAULT_TPU_HBM_BYTES = _knob_default("CYLON_HBM_BYTES")
 
 
 class MemoryPool:
@@ -46,8 +54,7 @@ class MemoryPool:
         if not self._devices and any(
                 getattr(d, "platform", "") in ("tpu", "axon")
                 for d in devices):
-            self._fallback_limit = int(os.environ.get(
-                "CYLON_HBM_BYTES", DEFAULT_TPU_HBM_BYTES))
+            self._fallback_limit = int(_knob_get("CYLON_HBM_BYTES"))
 
     def set_external_source(self, fn: Optional[Callable[[], int]]) -> None:
         """Register a fallback live-bytes provider (the telemetry

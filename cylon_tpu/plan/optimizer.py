@@ -30,10 +30,10 @@ Pass order is load-bearing:
 """
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Set, Tuple
 
+from ..telemetry import knobs as _knobs
 from . import ir
 
 
@@ -301,7 +301,7 @@ def optimize(root: ir.PlanNode, world: int
     root = pushdown_filters(root, stats)
     root = prune_projections(root, stats)
     root = elide_shuffles(root, world, stats)
-    if os.environ.get("CYLON_TPU_VERIFY_PLANS") == "1":
+    if _knobs.get("CYLON_TPU_VERIFY_PLANS"):
         from .verify import check_plan
 
         check_plan(root, world)

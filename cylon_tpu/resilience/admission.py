@@ -43,12 +43,13 @@ from typing import Dict, List, Optional
 
 from ..status import CylonResourceExhausted
 from ..telemetry import flight as _flight
+from ..telemetry import knobs as _knobs
 from ..telemetry import logger as _logger
 from ..telemetry import metrics as _metrics
 from ..telemetry import span as _span
 from . import inject as _inject
 
-DEFAULT_SHED_FACTOR = 8.0
+DEFAULT_SHED_FACTOR = _knobs.default("CYLON_SHED_FACTOR")
 
 # degraded joins never chunk below this many probe rows per block —
 # sub-1k blocks pay more per-dispatch overhead than they save memory
@@ -56,8 +57,7 @@ MIN_BLOCK_ROWS = 1 << 10
 
 
 def shed_factor() -> float:
-    return _metrics.env_number("CYLON_SHED_FACTOR",
-                               DEFAULT_SHED_FACTOR, lo=1.0)
+    return _knobs.get("CYLON_SHED_FACTOR")
 
 
 def effective_budget(pool) -> Optional[int]:

@@ -38,7 +38,6 @@ chaos failure's dump names the fault that caused it.
 """
 from __future__ import annotations
 
-import os
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -46,6 +45,7 @@ from typing import Dict, List, Optional
 from ..status import (CylonDataError, CylonPlanError,
                       CylonResourceExhausted, CylonTransientError)
 from ..telemetry import flight as _flight
+from ..telemetry import knobs as _knobs
 from ..telemetry import metrics as _metrics
 
 PLAN_ENV = "CYLON_FAULT_PLAN"
@@ -129,19 +129,25 @@ def arm(plan: Optional[str] = None) -> List[FaultSpec]:
     """Arm a fault plan (default: ``CYLON_FAULT_PLAN``); resets arrival
     counters. Returns the parsed specs (empty when nothing to arm)."""
     global _state, _env_checked
-    text = plan if plan is not None else os.environ.get(PLAN_ENV, "")
+    text = plan if plan is not None else \
+        (_knobs.get(PLAN_ENV) or "")
     with _lock:
         _env_checked = True
         if not text:
             _state = None
             _metrics.set_factory_fault_hook(None)
             return []
-        _state = _State(text, parse_plan(text))
-        if any(s.site == "compile" for s in _state.specs):
+        # publish via a local so the return below never re-reads the
+        # global outside the lock (a concurrent disarm() between the
+        # critical section and the return would None it out from under
+        # us — the concurrency checker's lock-discipline rule)
+        st = _State(text, parse_plan(text))
+        _state = st
+        if any(s.site == "compile" for s in st.specs):
             _metrics.set_factory_fault_hook(_compile_fault_hook)
         else:
             _metrics.set_factory_fault_hook(None)
-    return list(_state.specs)
+    return list(st.specs)
 
 
 def disarm() -> None:
@@ -161,13 +167,13 @@ def _current() -> Optional[_State]:
     """The armed state, lazily arming from the environment exactly once
     (so env-driven chaos needs no import-order ceremony)."""
     global _env_checked
-    if _state is None and not _env_checked:
-        if os.environ.get(PLAN_ENV):
+    if _state is None and not _env_checked:  # cylint: disable=concurrency/lock-discipline — double-checked lazy arm: reference reads are GIL-atomic; two racers at worst both run arm(), which is locked and idempotent
+        if _knobs.get(PLAN_ENV):
             arm()
         else:
             with _lock:
                 _env_checked = True
-    return _state
+    return _state  # cylint: disable=concurrency/lock-discipline — GIL-atomic reference read is the fire() fast path; all mutation of the returned _State happens under _lock
 
 
 def fire(site: str, detail: str = "") -> None:
@@ -215,7 +221,7 @@ def budget_clamp() -> Optional[int]:
 def state() -> dict:
     """Armed plan + arrival counters + fired events — the crash dump's
     ``faults`` section, so a chaos dump names its own cause."""
-    st = _state
+    st = _state  # cylint: disable=concurrency/lock-discipline — GIL-atomic snapshot; the lock below guards the captured state's fields, a racing disarm just yields a stale (consistent) report
     if st is None:
         return {"armed": None, "arrivals": {}, "fired": []}
     with _lock:

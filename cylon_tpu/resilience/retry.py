@@ -36,23 +36,22 @@ from typing import Callable, Iterator, Optional, TypeVar
 from ..status import CylonTimeoutError, classify
 from ..telemetry import annotate as _annotate
 from ..telemetry import current_span as _current_span
+from ..telemetry import knobs as _knobs
 from ..telemetry import logger as _logger
 from ..telemetry import metrics as _metrics
 
 T = TypeVar("T")
 
-DEFAULT_MAX_ATTEMPTS = 3
-DEFAULT_BACKOFF_S = 0.05
+DEFAULT_MAX_ATTEMPTS = _knobs.default("CYLON_RETRY_MAX")
+DEFAULT_BACKOFF_S = _knobs.default("CYLON_RETRY_BACKOFF_S")
 
 
 def max_attempts() -> int:
-    return _metrics.env_number("CYLON_RETRY_MAX", DEFAULT_MAX_ATTEMPTS,
-                               lo=1, as_int=True)
+    return _knobs.get("CYLON_RETRY_MAX")
 
 
 def backoff_base_s() -> float:
-    return _metrics.env_number("CYLON_RETRY_BACKOFF_S",
-                               DEFAULT_BACKOFF_S, lo=0.0)
+    return _knobs.get("CYLON_RETRY_BACKOFF_S")
 
 
 # ---------------------------------------------------------------------------
@@ -65,7 +64,7 @@ _deadline: ContextVar[Optional[float]] = ContextVar(
 
 
 def _env_deadline_s() -> Optional[float]:
-    s = _metrics.env_number("CYLON_QUERY_DEADLINE_S", None)
+    s = _knobs.get("CYLON_QUERY_DEADLINE_S")
     return s if s is not None and s > 0 else None
 
 

@@ -55,7 +55,6 @@ from __future__ import annotations
 
 import copy
 import hashlib
-import os
 import threading
 from collections import OrderedDict
 from contextlib import contextmanager
@@ -65,16 +64,16 @@ from typing import Optional, Tuple
 from ..plan import ir
 from ..plan.optimizer import PlanStats, optimize as _optimize
 from ..plan.verify import check_plan as _check_plan
+from ..telemetry import knobs as _knobs
 from ..telemetry import metrics as _metrics
 
-DEFAULT_CACHE_MAX = 64
+DEFAULT_CACHE_MAX = _knobs.default("CYLON_PLAN_CACHE_MAX")
 
 FP_VERSION = 1
 
 
 def cache_max() -> int:
-    return _metrics.env_number("CYLON_PLAN_CACHE_MAX", DEFAULT_CACHE_MAX,
-                               lo=0, as_int=True)
+    return _knobs.get("CYLON_PLAN_CACHE_MAX")
 
 
 # ---------------------------------------------------------------------------
@@ -230,7 +229,7 @@ class PlanCache:
         for d, s in zip(dst, src):
             d.table = s.table
             d.table_id = s.table_id
-        if os.environ.get("CYLON_TPU_VERIFY_PLANS") == "1":
+        if _knobs.get("CYLON_TPU_VERIFY_PLANS"):
             try:
                 _check_plan(plan, world)
             except Exception:
@@ -256,7 +255,7 @@ def global_cache() -> PlanCache:
 
 
 def _bypassed() -> bool:
-    return _bypass > 0
+    return _bypass > 0  # cylint: disable=concurrency/lock-discipline — advisory GIL-atomic int read on the per-optimize fast path; the bench bypass tolerates one racing query either way
 
 
 @contextmanager

@@ -61,12 +61,13 @@ from ..resilience import retry as _retry
 from ..status import (Code, CylonPlanError, CylonResourceExhausted,
                       CylonTimeoutError)
 from ..telemetry import flight as _flight
+from ..telemetry import knobs as _knobs
 from ..telemetry import logger as _logger
 from ..telemetry import metrics as _metrics
 from ..telemetry import root_attrs as _root_attrs
 
-DEFAULT_QUEUE_MAX = 256
-DEFAULT_QUANTUM_BYTES = 1 << 20
+DEFAULT_QUEUE_MAX = _knobs.default("CYLON_SERVICE_QUEUE_MAX")
+DEFAULT_QUANTUM_BYTES = _knobs.default("CYLON_SERVICE_QUANTUM_BYTES")
 
 # submit→dispatch wait histogram bounds, in SECONDS (the default
 # bucket set is ms-scaled for span latencies; queue waits span
@@ -80,13 +81,11 @@ _query_ids = itertools.count(1)
 
 
 def queue_max() -> int:
-    return _metrics.env_number("CYLON_SERVICE_QUEUE_MAX",
-                               DEFAULT_QUEUE_MAX, lo=1, as_int=True)
+    return _knobs.get("CYLON_SERVICE_QUEUE_MAX")
 
 
 def quantum_bytes() -> int:
-    return _metrics.env_number("CYLON_SERVICE_QUANTUM_BYTES",
-                               DEFAULT_QUANTUM_BYTES, lo=1, as_int=True)
+    return _knobs.get("CYLON_SERVICE_QUANTUM_BYTES")
 
 
 class QueryTicket:

@@ -55,7 +55,7 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       metrics_snapshot, record_host_sync, reset_metrics,
                       sample_memory, set_memory_pool, get_memory_pool)
 from .export import JsonlSpanSink, prometheus_text, span_to_json
-from . import ledger, profiler, skew
+from . import knobs, ledger, profiler, skew
 from . import flight
 from .skew import SkewStats
 
@@ -73,4 +73,6 @@ __all__ = [
     "JsonlSpanSink", "prometheus_text", "span_to_json",
     # skew + compile-cost + memory-lifetime + failure observability
     "profiler", "skew", "SkewStats", "ledger", "flight",
+    # the declared CYLON_* environment-knob registry
+    "knobs",
 ]

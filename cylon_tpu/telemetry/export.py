@@ -96,16 +96,19 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
         if m.kind in ("counter", "gauge"):
             lines.append(f"{format_series(name, labels)} {_fmt(m.value)}")
             continue
-        # histogram: cumulative buckets + sum + count
+        # histogram: cumulative buckets + sum + count, read as one
+        # locked group so _count always agrees with the +Inf bucket
+        st = m.stats()
         cum = 0
-        for bound, c in zip(m.buckets, m.counts):
+        for bound, c in zip(m.buckets, st["counts"]):
             cum += c
             lbl = labels + (("le", _fmt(bound)),)
             lines.append(f"{format_series(name + '_bucket', lbl)} {cum}")
-        cum += m.counts[-1]
+        cum += st["counts"][-1]
         lbl = labels + (("le", "+Inf"),)
         lines.append(f"{format_series(name + '_bucket', lbl)} {cum}")
         lines.append(f"{format_series(name + '_sum', labels)} "
-                     f"{_fmt(m.sum)}")
-        lines.append(f"{format_series(name + '_count', labels)} {m.count}")
+                     f"{_fmt(st['sum'])}")
+        lines.append(f"{format_series(name + '_count', labels)} "
+                     f"{st['count']}")
     return "\n".join(lines) + "\n"

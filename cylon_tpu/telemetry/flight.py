@@ -48,36 +48,40 @@ The resilience layer records into two extension points here:
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from . import knobs as _knobs
 from . import ledger as _ledger
 from . import metrics as _metrics
 from . import spans as _spans
 
 DUMP_SCHEMA_VERSION = 2
 
-DEFAULT_RING_SIZE = 16
+DEFAULT_RING_SIZE = _knobs.default("CYLON_FLIGHT_RING")
 
-DEFAULT_MAX_DUMPS = 32
+DEFAULT_MAX_DUMPS = _knobs.default("CYLON_FLIGHT_MAX_DUMPS")
 
 
 def _ring_size() -> int:
-    return _metrics.env_number("CYLON_FLIGHT_RING", DEFAULT_RING_SIZE,
-                               lo=1, as_int=True)
+    return _knobs.get("CYLON_FLIGHT_RING")
 
 
 def _max_dumps() -> int:
-    return _metrics.env_number("CYLON_FLIGHT_MAX_DUMPS",
-                               DEFAULT_MAX_DUMPS, lo=1, as_int=True)
+    return _knobs.get("CYLON_FLIGHT_MAX_DUMPS")
 
 
 _ring: deque = deque(maxlen=_ring_size())
 _admissions: deque = deque(maxlen=_ring_size())
-_dump_seq = 0
+# itertools.count: dump sequence allocation is atomic — root spans can
+# close errored on several threads at once, and a racy `+= 1` would
+# hand two dumps the same filename (the second silently overwrites the
+# first crash's forensics)
+_dump_seq = itertools.count(1)
 
 # crash-dump section providers: name -> zero-arg callable returning a
 # JSON-able value (resilience/inject registers its fault state here)
@@ -192,14 +196,13 @@ def write_crash_dump(root, directory: Optional[str] = None
     ``directory`` (default ``CYLON_FLIGHT_DIR``); returns the path, or
     None when no directory is configured. Never raises — a failing
     forensics path must not mask the original error."""
-    global _dump_seq
-    directory = directory or os.environ.get("CYLON_FLIGHT_DIR")
+    directory = directory or _knobs.get("CYLON_FLIGHT_DIR")
     if not directory:
         return None
     try:
         os.makedirs(directory, exist_ok=True)
-        _dump_seq += 1
-        name = (f"cylon-crash-{os.getpid()}-{_dump_seq:03d}-"
+        seq = next(_dump_seq)
+        name = (f"cylon-crash-{os.getpid()}-{seq:03d}-"
                 f"{root.name.replace('/', '_')}.json")
         path = os.path.join(directory, name)
         with open(path, "w", encoding="utf-8") as f:

@@ -217,7 +217,7 @@ def live_bytes() -> int:
     """Total DISTINCT tracked live buffer bytes (shared-buffer views
     refcount, never double-count) — the MemoryPool's external fallback
     source on backends that hide memory_stats."""
-    return _live_total
+    return _live_total  # cylint: disable=concurrency/lock-discipline — single int read under the GIL; the watermark fallback tolerates momentary staleness, and taking _lock here would serialize every pool snapshot
 
 
 def outstanding(include_borrowed: bool = True) -> List[dict]:

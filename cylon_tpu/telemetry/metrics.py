@@ -25,43 +25,34 @@ Well-known series (full catalog: docs/telemetry.md):
 from __future__ import annotations
 
 import functools
-import os
 import threading
 from typing import Callable, Dict, Optional, Tuple
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
 
-def env_number(name: str, default, lo=None, as_int: bool = False):
-    """Numeric env-var knob with a floor and a silent fallback — THE
-    parse for every CYLON_* tuning variable (flight ring/dump caps,
-    retry budget/backoff, shed factor): unset or malformed reads as
-    ``default``, ``lo`` floors the result. One copy, so a future
-    policy change (logging malformed values, say) lands everywhere."""
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        v = int(raw) if as_int else float(raw)
-    except ValueError:
-        return default
-    return max(v, lo) if lo is not None else v
-
-
 class Counter:
-    """Monotonically increasing value."""
+    """Monotonically increasing value.
+
+    ``inc`` is a read-modify-write: submitter threads, the service
+    worker and GC finalizers all increment concurrently, so it runs
+    under a per-metric RLock (reentrant — a weakref callback firing
+    mid-``inc`` on the same thread must never deadlock)."""
 
     kind = "counter"
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self):
         self.value = 0
+        self._lock = threading.RLock()
 
     def inc(self, n=1) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
     def zero(self) -> None:
-        self.value = 0
+        with self._lock:
+            self.value = 0
 
 
 class Gauge:
@@ -87,21 +78,28 @@ DEFAULT_BUCKETS_MS = (0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
 
 
 class Histogram:
-    """Cumulative-bucket histogram with sum/count/min/max."""
+    """Cumulative-bucket histogram with sum/count/min/max.
+
+    ``observe`` updates six fields; the per-metric RLock keeps the
+    group consistent under concurrent observers (every thread that
+    closes a span feeds the phase-latency series)."""
 
     kind = "histogram"
-    __slots__ = ("buckets", "counts", "count", "sum", "min", "max")
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max",
+                 "_lock")
 
     def __init__(self, buckets=DEFAULT_BUCKETS_MS):
         self.buckets = tuple(buckets)
+        self._lock = threading.RLock()
         self.zero()
 
     def zero(self) -> None:
-        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf
-        self.count = 0
-        self.sum = 0.0
-        self.min = None
-        self.max = None
+        with self._lock:
+            self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+            self.count = 0
+            self.sum = 0.0
+            self.min = None
+            self.max = None
 
     def observe(self, v: float) -> None:
         i = 0
@@ -110,11 +108,23 @@ class Histogram:
                 break
         else:
             i = len(self.buckets)
-        self.counts[i] += 1
-        self.count += 1
-        self.sum += v
-        self.min = v if self.min is None else min(self.min, v)
-        self.max = v if self.max is None else max(self.max, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    def stats(self) -> dict:
+        """Consistent read of the six-field group under the same lock
+        the writers hold — a reader interleaving a half-applied
+        observe() would see count/sum disagree (and a _count line
+        disagreeing with the cumulative +Inf bucket in the Prometheus
+        dump)."""
+        with self._lock:
+            return {"count": self.count, "sum": self.sum,
+                    "min": self.min, "max": self.max,
+                    "counts": list(self.counts)}
 
 
 def _series_key(name: str, labels: Optional[Dict[str, str]]) -> tuple:
@@ -135,7 +145,11 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[tuple, object] = {}
-        self._lock = threading.Lock()
+        # RLock, not Lock: the ledger's weakref-retire callback reaches
+        # gauge() from GC, which can fire on a thread ALREADY inside
+        # _get's critical section (metric construction allocates) — a
+        # non-reentrant lock would deadlock that thread against itself
+        self._lock = threading.RLock()
 
     def _get(self, cls, name: str, labels=None, **kw):
         key = _series_key(name, labels)
@@ -172,8 +186,10 @@ class MetricsRegistry:
         for name, labels, m in self.series():
             key = format_series(name, labels)
             if m.kind == "histogram":
-                out[key] = {"count": m.count, "sum": round(m.sum, 3),
-                            "min": m.min, "max": m.max}
+                st = m.stats()
+                out[key] = {"count": st["count"],
+                            "sum": round(st["sum"], 3),
+                            "min": st["min"], "max": st["max"]}
             else:
                 out[key] = m.value
         return out

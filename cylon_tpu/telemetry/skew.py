@@ -35,17 +35,17 @@ stops routing padded and starts paying blockwise rounds).
 """
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 from typing import List, Optional
 
 import numpy as np
 
+from . import knobs as _knobs
 from . import metrics as _metrics
 
 # imbalance (recv_max/recv_mean) above this renders a [SKEW] warning in
 # EXPLAIN ANALYZE; aligned with shuffle.PADDED_WASTE_FACTOR by default
-DEFAULT_WARN_FACTOR = 2.0
+DEFAULT_WARN_FACTOR = _knobs.default("CYLON_SKEW_WARN_FACTOR")
 
 # per-shard row-count histogram buckets (rows, log-spaced: one sublane
 # to a full HBM-scale shard)
@@ -65,11 +65,7 @@ SPAN_ATTR_MAX_WORLD = 16
 
 def warn_factor() -> float:
     """The configurable skew-warning threshold (env override)."""
-    try:
-        return float(os.environ.get("CYLON_SKEW_WARN_FACTOR",
-                                    DEFAULT_WARN_FACTOR))
-    except ValueError:  # pragma: no cover - malformed env
-        return DEFAULT_WARN_FACTOR
+    return _knobs.get("CYLON_SKEW_WARN_FACTOR")
 
 
 @dataclass

@@ -38,7 +38,6 @@ from __future__ import annotations
 
 import itertools
 import logging
-import os
 import time
 from contextlib import contextmanager
 from contextvars import ContextVar
@@ -47,6 +46,7 @@ from typing import Callable, Dict, Iterator, List, Optional
 
 import jax
 
+from . import knobs as _knobs
 from . import metrics as _metrics
 
 logger = logging.getLogger("cylon_tpu")
@@ -75,9 +75,14 @@ _span_ids = itertools.count(1)
 # per span — a refcounted-counter read on ledger-backed pools, one
 # memory_stats runtime call per local device on stats-bearing
 # backends. CYLON_HBM_SPAN_ATTRS=0 turns it off for latency-critical
-# runs; the flight recorder's crash-time watermarks are unaffected
-# (sampled at dump time).
-_HBM_ATTRS = os.environ.get("CYLON_HBM_SPAN_ATTRS", "1") != "0"
+# runs (read live through the knob registry, so it can be flipped at
+# any time); the flight recorder's crash-time watermarks are
+# unaffected (sampled at dump time).
+
+
+def _hbm_attrs_on() -> bool:
+    return _knobs.get("CYLON_HBM_SPAN_ATTRS")
+
 
 # innermost open span of the current (async/thread) context, or None
 _current: ContextVar[Optional["Span"]] = ContextVar(
@@ -265,7 +270,7 @@ def span(name: str, seq: Optional[int] = None, **attrs) -> Iterator[Span]:
     # hbm_delta/hbm_peak attrs. On backends that hide memory_stats the
     # pool reads the ledger's tracked bytes, so the attrs stay live
     # through the axon tunnel and on the CPU test mesh.
-    pool = _metrics.get_memory_pool() if _HBM_ATTRS else None
+    pool = _metrics.get_memory_pool() if _hbm_attrs_on() else None
     if pool is not None:
         try:
             s._hbm0 = int(pool.snapshot()[0])

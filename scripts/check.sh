@@ -5,10 +5,35 @@ set -e
 cd "$(dirname "$0")/.."
 
 echo "== static analysis: python -m cylon_tpu.analysis =="
-# all seven checker families (layering, hostsync, collectives, witness,
-# span-coverage, ledger-coverage, errors); any unsuppressed finding
-# fails the gate before tests
+# all nine checker families (layering, hostsync, collectives, witness,
+# span-coverage, ledger-coverage, errors, concurrency, envknobs); any
+# unsuppressed finding fails the gate before tests
 python -m cylon_tpu.analysis
+
+echo "== concurrency smoke: --families concurrency --json under 30s =="
+# the race detector closes a transitive call graph over the whole
+# package; this budget assertion makes sure that closure never silently
+# turns the gate unusably slow, and pins the JSON contract CI consumes
+python - <<'EOF'
+import json, subprocess, sys, time
+t0 = time.monotonic()
+proc = subprocess.run(
+    [sys.executable, "-m", "cylon_tpu.analysis",
+     "--families", "concurrency", "--json"],
+    capture_output=True, text=True)
+wall = time.monotonic() - t0
+if proc.returncode != 0:
+    sys.exit("concurrency smoke: real tree not clean (exit %d)\n%s"
+             % (proc.returncode, proc.stdout + proc.stderr))
+doc = json.loads(proc.stdout)
+assert doc["version"] == 1, doc["version"]
+assert "concurrency" in doc["checkers"], doc["checkers"]
+assert doc["ok"] and not doc["findings"], doc["findings"]
+if wall >= 30.0:
+    sys.exit("concurrency smoke: %.1fs wall, budget is 30s — "
+             "call-graph closure has regressed" % wall)
+print("concurrency smoke ok: clean in %.1fs (budget 30s)" % wall)
+EOF
 
 echo "== telemetry smoke: scripts/smoke_telemetry.py =="
 # a two-shuffle pipeline must produce a parseable JSONL trace (with

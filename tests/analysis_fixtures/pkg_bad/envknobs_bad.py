@@ -1,0 +1,35 @@
+"""Seeded envknobs violations: rogue CYLON_* environment reads outside
+the declared registry, an ad-hoc env_number parse, and an undeclared
+knob name (envknobs/*)."""
+import os
+
+from .telemetry import knobs
+
+
+def rogue_reads():
+    secret = os.environ["CYLON_SECRET"]          # SEEDED: unregistered-read
+    rogue = os.environ.get("CYLON_ROGUE", "1")   # SEEDED: unregistered-read
+    shadow = os.getenv("CYLON_SHADOW")           # SEEDED: unregistered-read
+    quiet = os.environ.get("CYLON_QUIET")  # cylint: disable=envknobs/unregistered-read — fixture: the suppressed control
+    return secret, rogue, shadow, quiet
+
+
+def adhoc_parse():
+    return env_number("CYLON_ADHOC", 3)          # SEEDED: unregistered-read
+
+
+def env_number(name, default):
+    return default
+
+
+def declared_and_not():
+    ok = knobs.get("CYLON_FIXTURE_OK")           # declared: clean
+    bad = knobs.get("CYLON_NOT_DECLARED")        # SEEDED: undeclared-knob
+    return ok, bad
+
+
+def flip_knob():
+    # a knob WRITE (how tests/operators flip a live knob) is not a
+    # read — must NOT be flagged
+    os.environ["CYLON_FIXTURE_OK"] = "1"         # clean: Store context
+    del os.environ["CYLON_FIXTURE_OK"]           # clean: Del context

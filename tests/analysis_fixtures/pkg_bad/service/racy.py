@@ -1,0 +1,133 @@
+"""Seeded concurrency violations: a two-domain unlocked counter, a
+lock-discipline break, lock-held blocking calls (direct + transitive)
+and an unstamped worker contextvar read (concurrency/*)."""
+import threading
+import time
+from contextvars import ContextVar
+
+_tenant = ContextVar("fixture_tenant")
+
+
+class RacyService:
+    """Spawns a worker thread; its public methods are the submitter
+    (api) surface the checker races against the worker domain."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0      # construction-time init: never flagged
+        self._total = 0
+        self._fut = None
+        self._worker = threading.Thread(target=self._run)
+
+    def _run(self):
+        while True:
+            self.count += 1            # SEEDED: unlocked-shared-write
+            tenant = _tenant.get()     # SEEDED: unstamped-contextvar
+            del tenant
+            with self._lock:
+                self._total += 1       # locked write: sets the discipline
+            time.sleep(0.01)           # not under a lock: legal
+
+    def submit(self, fut):
+        self.count += 1                # SEEDED: unlocked-shared-write
+        self._fut = fut  # cylint: disable=concurrency/unlocked-shared-write — fixture: the suppressed control
+        with self._lock:
+            return self._fut.result()  # SEEDED: blocking-under-lock
+
+    def totals(self):
+        return self._total             # SEEDED: lock-discipline
+
+    def drain(self):
+        with self._lock:
+            self._flush()              # SEEDED: blocking-under-lock (transitive)
+
+    def _flush(self):
+        time.sleep(0.05)
+
+
+_registry = {}  # module global: the worker writes it, the api reads it
+
+
+class ShadowedRacy:
+    """Regression pins for the checker-review fixes: a nested def's
+    local assignment must not shadow a module global out of the OUTER
+    scope's scan; bare ``queue.get()`` under a lock blocks
+    indefinitely; the explicit non-blocking spellings are legal."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = None  # stands in for queue.Queue()
+        self._worker = threading.Thread(target=self._poll)
+
+    def _poll(self):
+        _registry["n"] = 1             # SEEDED: unlocked-shared-write
+
+        def _helper():                 # nested scope: its local below
+            _registry = []             # must NOT hide line 63's write
+            return _registry
+        del _helper
+
+    def peek(self):
+        return len(_registry)          # api-domain read: spans 2 domains
+
+    def fetch(self):
+        with self._lock:
+            return self._q.get()       # SEEDED: blocking-under-lock (bare get)
+
+    def try_fetch(self):
+        with self._lock:
+            if self._lock.acquire(blocking=False):  # control: never blocks
+                self._lock.release()
+            return self._q.get(block=False)         # control: never blocks
+
+    def _setup_mixed(self):
+        # private + never called from an entry point: these init
+        # writes are reachable from no domain and stay silent
+        self._lock_b = threading.Lock()
+        self._mixed = 0
+
+    def bump_a(self):
+        with self._lock:
+            self._mixed += 1           # SEEDED: lock-discipline (inconsistent locks)
+
+    def bump_b(self):
+        with self._lock_b:
+            self._mixed += 2           # SEEDED: lock-discipline (inconsistent locks)
+
+
+from ..telemetry.gc_bad import gc_tenant  # noqa: E402  (service -> telemetry: legal)
+
+
+class CrossVarWorker:
+    """Cross-module contextvar read: ``gc_tenant`` is DECLARED in
+    telemetry.gc_bad but read by this worker — name-level matching
+    must still see the unstamped read."""
+
+    def __init__(self):
+        self._worker = threading.Thread(target=self._spin)
+
+    def _spin(self):
+        return gc_tenant.get()        # SEEDED: unstamped-contextvar (cross-module)
+
+
+class CvWaiter:
+    """CLEAN control: Condition.wait refactored into a helper only
+    ever called under ``with self._cv:`` — the caller-inherited lock
+    must keep the wait legal (no blocking-under-lock on _loop or
+    _wait_ready). ``paired`` seeds the multi-item-with case: item 2
+    evaluates with item 1 already held."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._worker = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        with self._cv:
+            self._wait_ready()         # clean: inherited held cv
+
+    def _wait_ready(self):
+        self._cv.wait()                # clean: cv.wait releases the cv
+
+    def paired(self, fut):
+        with self._cv, fut.result():   # SEEDED: blocking-under-lock (2nd with item)
+            pass

@@ -1,0 +1,30 @@
+"""Seeded finalizer hazards: a ledger-style weakref callback acquiring
+a NON-reentrant lock and dispatching through jax
+(concurrency/finalizer-hazard)."""
+import threading
+import weakref
+
+import jax
+
+_plain = threading.Lock()
+_entries = {}
+
+
+def register(table):
+    wr = weakref.ref(table, _on_gc)
+    _entries[id(table)] = wr
+    return wr
+
+
+def _on_gc(wr):
+    with _plain:                 # SEEDED: finalizer-hazard (plain Lock)
+        _entries.clear()
+    jax.device_get(wr)           # SEEDED: finalizer-hazard (jax in GC)
+
+
+# declared here (telemetry) and READ cross-module by service.racy's
+# CrossVarWorker — that import direction (service -> telemetry) is the
+# layering-legal one; appended after the defs to keep line pins stable
+from contextvars import ContextVar  # noqa: E402
+
+gc_tenant = ContextVar("gc_tenant")

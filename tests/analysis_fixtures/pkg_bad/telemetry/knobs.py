@@ -1,0 +1,15 @@
+"""Fixture knob registry: the envknobs family parses these declare()
+calls to know which CYLON_* names the tree registers."""
+KNOBS = {}
+
+
+def declare(name, default, kind, doc):
+    KNOBS[name] = (default, kind, doc)
+    return name
+
+
+def get(name):
+    return KNOBS[name][0]
+
+
+declare("CYLON_FIXTURE_OK", 1, "int", "the one declared fixture knob")

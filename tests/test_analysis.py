@@ -287,6 +287,178 @@ def test_witness_default_corpus_clean():
 
 
 # ---------------------------------------------------------------------------
+# concurrency (thread-domain race detector)
+# ---------------------------------------------------------------------------
+
+
+def test_concurrency_fixture_reports_exactly_seeded():
+    """The seeded race classes all fire — two-domain unlocked counter
+    (both write sites), lock-discipline break, direct + transitive
+    blocking-under-lock (the transitive case flags the locked call
+    site AND the inherited-lock primitive site), unstamped worker
+    contextvar read, and both finalizer hazards — and the suppressed
+    control counts as suppressed, never as accepted."""
+    res = run_checkers(AnalysisContext(PKG_BAD),
+                       families=["concurrency"])
+    got = {(f.path, f.line, f.rule) for f in res.findings}
+    assert got == {
+        ("service/racy.py", 24, "concurrency/unlocked-shared-write"),
+        ("service/racy.py", 25, "concurrency/unstamped-contextvar"),
+        ("service/racy.py", 32, "concurrency/unlocked-shared-write"),
+        ("service/racy.py", 35, "concurrency/blocking-under-lock"),
+        ("service/racy.py", 38, "concurrency/lock-discipline"),
+        ("service/racy.py", 42, "concurrency/blocking-under-lock"),
+        ("service/racy.py", 45, "concurrency/blocking-under-lock"),
+        # review-fix pins: the nested _helper's local _registry must
+        # not hide the outer _poll's global write, and a bare
+        # queue-shaped .get() under a lock blocks indefinitely — while
+        # the explicit non-blocking spellings (acquire(blocking=False),
+        # get(block=False) at lines 79/81) stay legal
+        ("service/racy.py", 63, "concurrency/unlocked-shared-write"),
+        ("service/racy.py", 75, "concurrency/blocking-under-lock"),
+        # two writers under two DIFFERENT locks do not exclude each
+        # other: the guard is the intersection of locks held at every
+        # locked write, and an empty intersection flags each write
+        ("service/racy.py", 91, "concurrency/lock-discipline"),
+        ("service/racy.py", 95, "concurrency/lock-discipline"),
+        # contextvar matching is name-level, so a var imported from its
+        # declaring module (telemetry.gc_bad) is still seen in the
+        # importing module's worker code
+        ("service/racy.py", 110, "concurrency/unstamped-contextvar"),
+        # a multi-item with: the 2nd item's expression evaluates with
+        # the 1st item's lock already held (CvWaiter's clean cv.wait
+        # helper idiom is pinned by ABSENCE — no findings on
+        # _loop/_wait_ready, the caller-inherited cv keeps wait legal)
+        ("service/racy.py", 132, "concurrency/blocking-under-lock"),
+        ("telemetry/gc_bad.py", 20, "concurrency/finalizer-hazard"),
+        ("telemetry/gc_bad.py", 22, "concurrency/finalizer-hazard"),
+    }, res.format_text()
+    # the suppressed _fut write (explicit per-line opt-out)
+    assert res.suppressed == 1
+
+
+def test_concurrency_reports_domain_and_chain():
+    """Findings carry the thread-domain reachability chain so a false
+    positive is cheap to triage: the transitive sleep names the
+    locked caller, the counter names both domains."""
+    res = run_checkers(AnalysisContext(PKG_BAD),
+                       families=["concurrency"])
+    by_line = {f.line: f.message for f in res.findings
+               if f.path == "service/racy.py"}
+    assert "drain" in by_line[45] and "_flush" in by_line[45]
+    assert "api" in by_line[24] and "worker:" in by_line[24]
+    # the finalizer hazard names the fix
+    gc_msgs = [f.message for f in res.findings
+               if f.path == "telemetry/gc_bad.py"]
+    assert any("RLock" in m for m in gc_msgs)
+    assert any("jax" in m for m in gc_msgs)
+    # the domain census rides the notes
+    assert any(n.startswith("concurrency: domains") for n in res.notes)
+
+
+def test_concurrency_real_tree_clean():
+    """The real service/telemetry/resilience tree passes the race
+    detector — every deliberate lock-free fast path (GIL-atomic
+    reference/int reads) carries a reasoned per-line opt-out, visible
+    as suppressions rather than silently accepted."""
+    res = run_checkers(AnalysisContext(PKG_REAL),
+                       families=["concurrency"])
+    assert res.findings == [], res.format_text()
+    assert res.suppressed >= 5
+    # the worker/api/finalizer/hook domains were actually discovered
+    note = next(n for n in res.notes
+                if n.startswith("concurrency: domains"))
+    for d in ("api", "finalizer", "hook", "worker:"):
+        assert d in note, note
+
+
+# ---------------------------------------------------------------------------
+# envknobs (declared CYLON_* knob registry)
+# ---------------------------------------------------------------------------
+
+
+def test_envknobs_fixture_reports_exactly_seeded():
+    res = run_checkers(AnalysisContext(PKG_BAD), families=["envknobs"])
+    got = {(f.path, f.line, f.rule) for f in res.findings}
+    assert got == {
+        ("envknobs_bad.py", 10, "envknobs/unregistered-read"),
+        ("envknobs_bad.py", 11, "envknobs/unregistered-read"),
+        ("envknobs_bad.py", 12, "envknobs/unregistered-read"),
+        ("envknobs_bad.py", 18, "envknobs/unregistered-read"),
+        ("envknobs_bad.py", 27, "envknobs/undeclared-knob"),
+    }, res.format_text()
+    # the suppressed CYLON_QUIET read
+    assert res.suppressed == 1
+    # fixture trees have no sibling docs/ — skipped with a note
+    assert any("documentation check skipped" in n for n in res.notes)
+
+
+def test_envknobs_real_tree_clean_zero_suppressions():
+    """Every CYLON_* read in the real package routes through
+    telemetry/knobs.py and every declared knob is documented — with
+    ZERO suppressions (the migration left no sanctioned ad-hoc
+    reads)."""
+    res = run_checkers(AnalysisContext(PKG_REAL), families=["envknobs"])
+    assert res.findings == [], res.format_text()
+    assert res.suppressed == 0
+    note = next(n for n in res.notes if "declared knobs" in n)
+    assert "0 unregistered read site(s)" in note
+
+
+def test_envknobs_real_registry_matches_docs_table():
+    """The generated table (knobs.render_table) is embedded verbatim in
+    docs/telemetry.md, so the docs can never drift from the code."""
+    from cylon_tpu.telemetry import knobs
+
+    docs = open(os.path.join(os.path.dirname(PKG_REAL), "docs",
+                             "telemetry.md"), encoding="utf-8").read()
+    assert knobs.render_table() in docs
+    # and the registry itself parses + floors like env_number did
+    assert knobs.get("CYLON_RETRY_MAX") == 3
+    assert knobs.default("CYLON_SERVICE_QUEUE_MAX") == 256
+
+
+def test_envknobs_undocumented_knob(tmp_path):
+    """A declared-but-undocumented knob is a finding anchored at its
+    declare() line when the tree has a sibling docs/telemetry.md."""
+    pkg = tmp_path / "pkg_knobs" / "telemetry"
+    pkg.mkdir(parents=True)
+    (tmp_path / "pkg_knobs" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "knobs.py").write_text(
+        "def declare(name, default, kind, doc):\n"
+        "    return name\n"
+        "declare('CYLON_DOCUMENTED', 1, 'int', 'yes')\n"
+        "declare('CYLON_GHOST', 1, 'int', 'no')\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "telemetry.md").write_text("only CYLON_DOCUMENTED here\n")
+    res = run_checkers(AnalysisContext(str(tmp_path / "pkg_knobs")),
+                       families=["envknobs"])
+    assert [(f.path, f.line, f.rule) for f in res.findings] == \
+        [("telemetry/knobs.py", 4, "envknobs/undocumented-knob")]
+    assert "CYLON_GHOST" in res.findings[0].message
+
+
+def test_new_families_in_fixture_cli_default():
+    """`python -m cylon_tpu.analysis --package-root <fixture>` runs
+    concurrency + envknobs by default and fails on the seeded races
+    and rogue env reads."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "cylon_tpu.analysis", "--package-root",
+         PKG_BAD],
+        capture_output=True, text=True, cwd=os.path.dirname(PKG_REAL),
+        env=env, timeout=300)
+    assert r.returncode == 1
+    assert "[concurrency/unlocked-shared-write]" in r.stdout
+    assert "[concurrency/blocking-under-lock]" in r.stdout
+    assert "[concurrency/finalizer-hazard]" in r.stdout
+    assert "[envknobs/unregistered-read]" in r.stdout
+    assert "[envknobs/undeclared-knob]" in r.stdout
+
+
+# ---------------------------------------------------------------------------
 # output schema + CLI
 # ---------------------------------------------------------------------------
 
