@@ -702,6 +702,13 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
     # ledger's end-of-run leak count — a growing leak count across
     # rounds is a regression even when throughput holds
     _hbm_used, _hbm_peak, _hbm_limit = ctx.memory_pool.snapshot()
+    # recompile-cardinality trajectory: every distinct (factory, input
+    # signature) the profiler measured is one compiled XLA program.
+    # Capacity bucketing (benchutils.bucket_cap, enforced statically by
+    # the specialization analysis family) bounds this per factory by
+    # the BUCKET count, not the distinct-value count — benchtrend
+    # tracks it lower-is-better across rounds
+    _compile_profile = _profiler.summary()
     return {
         "metric": "dist_inner_join_rows_per_sec_per_chip",
         "value": round(rps, 1),
@@ -721,7 +728,9 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
                 for k, v in local_res.items()},
             "shuffle_gbps": shuffle_res["gbps_per_chip"],
             "shuffle": shuffle_res,
-            "compile_profile": _profiler.summary(),
+            "compile_profile": _compile_profile,
+            "distinct_kernel_signatures": sum(
+                v["programs"] for v in _compile_profile.values()),
             "suite": {k: {kk: (_sig(vv) if isinstance(vv, float) else vv)
                           for kk, vv in v.items()}
                       for k, v in suite.items()},

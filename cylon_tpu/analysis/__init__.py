@@ -1,6 +1,6 @@
 """cylon_tpu.analysis — pluggable static-analysis suite.
 
-Nine checker families guard the invariants the paper's *local kernel +
+Ten checker families guard the invariants the paper's *local kernel +
 shuffle + local kernel* decomposition rests on (SURVEY §1), each
 registered in `core.CHECKERS` and runnable from one entry point:
 
@@ -43,7 +43,15 @@ registered in `core.CHECKERS` and runnable from one entry point:
 * ``envknobs``      — every ``CYLON_*`` environment read routes
                       through the declared knob registry
                       (telemetry/knobs.py) and every declared knob
-                      appears in the generated docs table.
+                      appears in the generated docs table;
+* ``specialization`` — kernel-specialization auditor: every
+                      ``counted_cache`` factory cache-key argument is
+                      classified (structural / schema-bound / bucketed
+                      / data-dependent / unbounded) by tracing it from
+                      the call site through the call graph; a runtime
+                      count reaching a cache key without a recognized
+                      bucketing helper is a finding — recompile
+                      cardinality stays bounded by construction.
 
 Run ``python -m cylon_tpu.analysis`` (see ``--help``); wired into
 ``scripts/check.sh`` ahead of tier-1. Rule catalog, suppression syntax
@@ -52,7 +60,8 @@ and extension guide: docs/analysis.md.
 from __future__ import annotations
 
 from .core import (AnalysisContext, CHECKERS, Finding, RunResult,
-                   SCHEMA_VERSION, register, run_checkers, to_json_text)
+                   SARIF_VERSION, SCHEMA_VERSION, register, run_checkers,
+                   to_json_text, to_sarif, to_sarif_text)
 
 # importing the checker modules registers them
 from . import layering as _layering          # noqa: F401,E402
@@ -64,6 +73,8 @@ from . import ledgercov as _ledgercov        # noqa: F401,E402
 from . import errors as _errors              # noqa: F401,E402
 from . import concurrency as _concurrency    # noqa: F401,E402
 from . import envknobs as _envknobs          # noqa: F401,E402
+from . import specialization as _specialization  # noqa: F401,E402
 
 __all__ = ["AnalysisContext", "CHECKERS", "Finding", "RunResult",
-           "SCHEMA_VERSION", "register", "run_checkers", "to_json_text"]
+           "SARIF_VERSION", "SCHEMA_VERSION", "register", "run_checkers",
+           "to_json_text", "to_sarif", "to_sarif_text"]

@@ -5,6 +5,7 @@ Wired into scripts/check.sh ahead of tier-1. Typical invocations:
 
     python -m cylon_tpu.analysis                    # full suite
     python -m cylon_tpu.analysis --json             # machine-readable
+    python -m cylon_tpu.analysis --format sarif     # SARIF v2.1.0 (CI)
     python -m cylon_tpu.analysis --families layering,hostsync
     python -m cylon_tpu.analysis --package-root tests/analysis_fixtures/pkg_bad
     python -m cylon_tpu.analysis --list-rules
@@ -33,7 +34,12 @@ def main(argv=None) -> int:
                     "(docs/analysis.md)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable output (stable schema, "
-                        "docs/analysis.md)")
+                        "docs/analysis.md); alias for --format json")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default=None,
+                   help="output format: text (default), json (stable "
+                        "schema v1), or sarif (SARIF v2.1.0 for CI "
+                        "inline annotation)")
     p.add_argument("--families",
                    help="comma-separated checker families to run "
                         "(default: all registered)")
@@ -52,7 +58,10 @@ def main(argv=None) -> int:
                    help="print registered checker families and exit")
     args = p.parse_args(argv)
 
-    from . import AnalysisContext, CHECKERS, run_checkers, to_json_text
+    from . import AnalysisContext, CHECKERS, run_checkers, \
+        to_json_text, to_sarif_text
+
+    fmt = args.format or ("json" if args.json else "text")
 
     if args.list_rules:
         for name in sorted(CHECKERS):
@@ -83,7 +92,7 @@ def main(argv=None) -> int:
         # and optimizer — run only the file-scanning families
         families = ["layering", "hostsync", "span-coverage",
                     "ledger-coverage", "errors", "concurrency",
-                    "envknobs"]
+                    "envknobs", "specialization"]
 
     ctx = AnalysisContext(root, options)
     try:
@@ -91,7 +100,8 @@ def main(argv=None) -> int:
     except ValueError as e:  # unknown --families entry
         print(f"error: {e}", file=sys.stderr)
         return 2
-    print(to_json_text(res) if args.json else res.format_text())
+    print({"json": to_json_text, "sarif": to_sarif_text}[fmt](res)
+          if fmt != "text" else res.format_text())
     return 0 if res.ok else 1
 
 

@@ -18,6 +18,33 @@ import numpy as np
 
 _DIV = {"ns": 1.0, "us": 1e3, "ms": 1e6, "s": 1e9}
 
+# bucket_cap's small-value floor: every capacity below it shares ONE
+# bucket (and one compiled program). 512 rows/words is well under a
+# single shard's working set at bench scale, so the extra padding on
+# tiny shapes costs noise while the merged buckets cut a long tail of
+# small-capacity recompiles.
+BUCKET_FLOOR = 512
+
+
+def bucket_cap(n: int, floor: int = BUCKET_FLOOR) -> int:
+    """Next-power-of-two capacity with a small-value floor — the ONE
+    bucketing policy for data-dependent kernel-factory cache keys.
+
+    Every ``counted_cache`` factory keyed on a runtime count (join
+    materialize cap, set-op cap, varlen word cap, ring slab steps)
+    routes the count through this helper, so the key's cardinality is
+    bounded by OCTAVES of the data size (1 bucket per octave above the
+    floor, 1 below) instead of one compiled XLA program per distinct
+    value. Padding rows/words past the true count are masked by the
+    kernels' emit discipline, so results are bit-identical to an exact
+    capacity — only compile cardinality changes. The ``specialization``
+    analysis family (docs/analysis.md) statically enforces that
+    capacity-keyed call sites use this helper (or ``util.pow2`` /
+    ``util.pow2_floor`` for exchange blocks)."""
+    from .util import pow2
+
+    return max(pow2(max(int(n), 1)), int(floor))
+
 
 def round_sig(x: float, sig: int = 6) -> float:
     """Round to ``sig`` SIGNIFICANT digits (not decimal places).

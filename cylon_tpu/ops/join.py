@@ -539,13 +539,16 @@ def stream_block_rows(na: int, nb: int) -> int:
 
 
 def stream_expand_capacity(n: int, block_rows: int):
-    """cap_e for join_expand_stream: the mantissa-rounded capacity lifted
-    to a whole number of expansion blocks."""
+    """cap_e for join_expand_stream: the pow2-bucketed capacity lifted
+    to a whole number of expansion blocks. cap_e is a jit cache-key
+    parameter on both the local and the distributed stream path, so it
+    routes through benchutils.bucket_cap (1 bucket per octave) rather
+    than the 16-per-octave mantissa rounding — the specialization
+    analysis recognizes this helper as bucketing."""
     blk = block_rows * 128
-    from ..util import capacity as _cap
+    from ..benchutils import bucket_cap as _bucket_cap
 
-    cap = _cap(max(n, 1))
-    return -(-cap // blk) * blk
+    return -(-_bucket_cap(n) // blk) * blk
 
 
 def _side_lanes(dat, val, desc):

@@ -50,6 +50,7 @@ from ..telemetry import annotate as _annotate, counted_cache, \
     ledger as _ledger, phase as _phase, record_host_sync as _host_sync, \
     span as _span
 from . import shard
+from ..benchutils import bucket_cap as _bucket_cap
 from ..util import capacity as _capacity
 from .shuffle import count_pair, exchange, exchange_pair, \
     replicated_gather
@@ -460,7 +461,7 @@ def _varlen_take_sharded(ctx: CylonContext, vb, idx) -> "object":
     counts = np.asarray(jax.device_get(
         _varlen_count_fn(ctx.mesh)(lengths, idx)))
     _host_sync("varlen.count")
-    cap_w = _capacity(max(int(counts.max()), 1))
+    cap_w = _bucket_cap(int(counts.max()))
     w, s, ln = _varlen_take_fn(ctx.mesh, cap_w)(words, starts, lengths, idx)
     world = ctx.get_world_size()
     return VarBytes(w, s, ln, vb.max_words, int(w.shape[0]),
@@ -482,7 +483,7 @@ def _dist_as_varbytes(ctx: CylonContext, col: Column) -> Column:
         _varlen_count_fn(ctx.mesh, replicated=True)(
             jax.device_put(vocab_vb.lengths), codes)))
     _host_sync("varlen.count")
-    cap_w = _capacity(max(int(counts.max()), 1))
+    cap_w = _bucket_cap(int(counts.max()))
     w, s, ln = _varlen_take_fn(ctx.mesh, cap_w, replicated=True)(
         vocab_vb.words, vocab_vb.starts, vocab_vb.lengths, codes)
     world = ctx.get_world_size()
@@ -1032,8 +1033,12 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
             counts = np.asarray(jax.device_get(counts2)).reshape(world, 2)
             _host_sync("join.plan")
             _annotate(rows_out=int(counts[:, 0].sum()))
-        cap_p = _capacity(int(counts[:, 0].max()))
-        cap_u = _capacity(int(counts[:, 1].max())) \
+        # bucket_cap, not util.capacity: these caps are cache-key
+        # parameters of _join_mat_fn — 1 bucket per octave bounds the
+        # recompile count under varied cardinalities (specialization
+        # analysis); padding rows are masked by emit, results identical
+        cap_p = _bucket_cap(int(counts[:, 0].max()))
+        cap_u = _bucket_cap(int(counts[:, 1].max())) \
             if jt == _join.JoinType.FULL_OUTER else 0
 
         with _span("distributed_join.materialize", seq, world=world,
@@ -1376,8 +1381,8 @@ def distributed_join_ring(left: Table, right: Table,
             abits, akv, aemit, bbits, bkv, bemit)))
         _host_sync("ring.count")
     pairs, extra = counts[:, :world], counts[:, world]
-    cap_step = _capacity(int(pairs.max())) if pairs.size else 1
-    cap_extra = _capacity(int(extra.max())) if emit_un_a else 0
+    cap_step = _bucket_cap(int(pairs.max())) if pairs.size else 1
+    cap_extra = _bucket_cap(int(extra.max())) if emit_un_a else 0
     # skew guard: the output slab is world*cap_step rows per shard, with
     # cap_step set by the WORST (shard, step) block — a hot key inflates
     # every shard's slab. When the slab overshoots the actual worst
@@ -1526,7 +1531,7 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp,
             lkb, lemit, rkb, remit))).reshape(world, 3)
         _host_sync("setop.count")
     total = counts[:, int(op)]
-    cap = _capacity(int(total.max()))
+    cap = _bucket_cap(int(total.max()))
 
     with _phase("distributed_set_op.materialize", seq):
         od, ov, emit, idx = _setop_mat_fn(ctx.mesh, op, cap)(
@@ -1543,7 +1548,7 @@ def distributed_set_op(left: Table, right: Table, op: _setops.SetOp,
                     shard.pin(a.varbytes.lengths, ctx),
                     shard.pin(bvb.lengths, ctx), idx)))
             _host_sync("varlen.count")
-            cap_w = _capacity(max(int(wcounts.max()), 1))
+            cap_w = _bucket_cap(int(wcounts.max()))
             w, s, ln = _varlen_take_concat_fn(ctx.mesh, cap_w)(
                 shard.pin(a.varbytes.words, ctx),
                 shard.pin(a.varbytes.starts, ctx),

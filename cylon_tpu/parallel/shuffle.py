@@ -52,7 +52,7 @@ from ..resilience import retry as _retry
 from ..telemetry import counted_cache, counter as _counter, \
     phase as _phase, record_host_sync as _host_sync, span as _span
 from ..telemetry import skew as _skew
-from ..util import pow2 as _pow2
+from ..util import pow2 as _pow2, pow2_floor as _pow2_floor
 
 # Upper bound on the per-round block (rows per (src,dst) pair per round).
 # Comm/scratch memory per leaf is 2*W*MAX_BLOCK rows; the memory-pool
@@ -537,7 +537,9 @@ def _budget_block_cap(payload, world: int, budget, mb: int,
     if budget:
         while mb > 1024 and buffer_factor * world * mb * bytes_per_row                 > budget:
             mb //= 2
-    return 1 << (max(int(mb), 1).bit_length() - 1)
+    # pow2_floor: the cap feeds block sizes that key compiled exchange
+    # programs — keep them 1-per-octave (specialization analysis)
+    return _pow2_floor(mb)
 
 
 def _padded_route(counts, payload, world: int, budget,

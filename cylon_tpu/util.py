@@ -9,6 +9,14 @@ def pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (int(n) - 1).bit_length()
 
 
+def pow2_floor(n: int) -> int:
+    """Round DOWN to a power of two (≥1) — the budget-shrink direction:
+    a comm-buffer cap halved to fit stays a pow2, so the block sizes it
+    feeds into kernel-factory cache keys keep 1-per-octave cardinality
+    (the specialization analysis recognizes this helper)."""
+    return 1 << (max(int(n), 1).bit_length() - 1)
+
+
 def capacity(n: int) -> int:
     """Static-capacity rounding with a 4-bit mantissa: the smallest
     s * 2^e ≥ n with s ∈ [17, 32]. Overshoot ≤ 6.25% (vs up to 100% for

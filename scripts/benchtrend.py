@@ -11,7 +11,9 @@ into (a) a human trend table and (b) a CI gate:
 
 A "metric" is any higher-is-better rate the artifacts carry — the
 primary distributed-join throughput, shuffle GB/s, every suite
-config's rows/s, the plan-pipeline speedup. Artifacts are
+config's rows/s, the plan-pipeline speedup — plus the lower-is-better
+``compile.distinct_kernel_signatures`` recompile-cardinality count
+(see LOWER_IS_BETTER), judged by rise instead of drop. Artifacts are
 heterogeneous across rounds (early rounds predate the suite; one round
 is rc=1 with ``parsed: null``; outage rounds fall back to a CPU mesh),
 so extraction is tolerant: missing metrics are blanks in the table,
@@ -42,6 +44,13 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 DEFAULT_THRESHOLD = 0.2
+
+# Metrics where SMALLER is the win: judged by rise, not drop. The
+# distinct-signature count is the recompile-cardinality trajectory the
+# capacity-bucketing work (specialization analysis, docs/analysis.md)
+# drives DOWN — a round that halves it must not trip the gate, and a
+# round that rebloats it past the threshold must.
+LOWER_IS_BETTER = {"compile.distinct_kernel_signatures"}
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -98,6 +107,9 @@ def flatten_metrics(parsed: Optional[dict]) -> Dict[str, float]:
     v = _num(det.get("shuffle_gbps"))
     if v is not None:
         out["shuffle.gbps"] = v
+    v = _num(det.get("distinct_kernel_signatures"))
+    if v is not None:
+        out["compile.distinct_kernel_signatures"] = v
     for name, cfg in (det.get("suite") or {}).items():
         if not isinstance(cfg, dict) or "error" in cfg:
             continue
@@ -210,7 +222,10 @@ def find_regressions(rounds: List[dict],
         new_v = lm.get(metric)
         if new_v is None:
             continue  # metric dropped from the artifact, not a perf claim
-        drop = (ref_v - new_v) / ref_v
+        if metric in LOWER_IS_BETTER:
+            drop = (new_v - ref_v) / ref_v  # a RISE is the regression
+        else:
+            drop = (ref_v - new_v) / ref_v
         if drop > threshold:
             out.append((metric, new_v, ref_v, drop))
     return out

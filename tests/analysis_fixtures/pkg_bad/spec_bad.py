@@ -1,0 +1,72 @@
+"""Fixture kernel factories for the specialization auditor: a bucketed
+clean control, a raw data-dependent cache key, an unprovable key, a
+closure-capture in a non-factory builder (with the counted_cache
+closure kept legal as a control), and a suppressed site."""
+import os
+
+import jax
+import numpy as np
+
+from .telemetry import counted_cache
+
+
+def bucket_cap(n):
+    """Recognized bucketing helper (name-level for fixture trees)."""
+    return max(1 << (int(n) - 1).bit_length(), 512)
+
+
+def _capacity(n):
+    """Fine-grained mantissa rounding — NOT a recognized bucket."""
+    return n
+
+
+@counted_cache
+def _clean_mat_fn(mesh, cap: int):
+    def kernel(x):
+        return x
+
+    return jax.jit(kernel)
+
+
+@counted_cache
+def _raw_mat_fn(mesh, cap: int):
+    def kernel(x):
+        return x
+
+    return jax.jit(kernel)
+
+
+@counted_cache
+def _mystery_fn(mesh, cap):
+    def kernel(x):
+        return x
+
+    return jax.jit(kernel)
+
+
+@counted_cache
+def _closes_over_key_fn(mesh, width: int):
+    lanes = width + 1  # derived from the cache key: legal to close over
+
+    def kernel(x):
+        return x + lanes
+
+    return jax.jit(kernel)
+
+
+def make_scaled(mesh, scale):
+    def kernel(x):
+        return x * scale  # SEEDED: closure-capture (no cache key)
+
+    return jax.jit(kernel)
+
+
+def run_ops(mesh, counts, opaque):
+    cap = int(np.asarray(jax.device_get(counts)).max())
+    _clean_mat_fn(mesh, bucket_cap(cap))            # clean: bucketed
+    _raw_mat_fn(mesh, cap)                          # SEEDED: unbucketed
+    _raw_mat_fn(mesh, _capacity(cap))               # SEEDED: mantissa
+    _mystery_fn(mesh, opaque())                     # SEEDED: unbounded
+    _closes_over_key_fn(mesh, 4)
+    n = int(os.environ.get("FIXTURE_ROWS", "64"))
+    _raw_mat_fn(mesh, n)  # cylint: disable=specialization/unbounded-key — suppression-count control (env-read source)

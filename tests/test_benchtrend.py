@@ -18,7 +18,7 @@ spec.loader.exec_module(benchtrend)
 
 
 def _artifact(value, backend="tpu", suite=None, shuffle_gbps=None,
-              local=None):
+              local=None, signatures=None):
     detail = {"backend": backend}
     if suite is not None:
         detail["suite"] = suite
@@ -26,6 +26,8 @@ def _artifact(value, backend="tpu", suite=None, shuffle_gbps=None,
         detail["shuffle_gbps"] = shuffle_gbps
     if local is not None:
         detail["local_inner_join"] = {"rows_per_s_per_chip": local}
+    if signatures is not None:
+        detail["distinct_kernel_signatures"] = signatures
     return {"metric": "dist_inner_join_rows_per_sec_per_chip",
             "value": value, "unit": "rows/s/chip", "detail": detail}
 
@@ -54,6 +56,23 @@ def test_flatten_metrics_shapes():
     assert not any(k.startswith("broken") for k in flat)
     assert benchtrend.flatten_metrics(None) == {}
     assert benchtrend.flatten_metrics({"value": 0}) == {}
+    flat = benchtrend.flatten_metrics(_artifact(1e6, signatures=37))
+    assert flat["compile.distinct_kernel_signatures"] == 37
+
+
+def test_signature_count_is_judged_lower_is_better(tmp_path):
+    """The recompile-cardinality metric inverts the gate: a round that
+    HALVES distinct signatures (the bucketing win) passes, a round
+    that rebloats them past the threshold fails."""
+    win = _write_rounds(tmp_path, {
+        1: _artifact(1e6, signatures=40),
+        2: _artifact(1e6, signatures=18)})
+    assert benchtrend.find_regressions(benchtrend.load_rounds(win)) == []
+    bloat = _write_rounds(tmp_path, {
+        1: _artifact(1e6, signatures=18),
+        2: _artifact(1e6, signatures=40)})
+    regs = benchtrend.find_regressions(benchtrend.load_rounds(bloat))
+    assert [r[0] for r in regs] == ["compile.distinct_kernel_signatures"]
 
 
 def test_no_regression_on_stable_trajectory(tmp_path):
