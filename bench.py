@@ -599,6 +599,12 @@ def bench_service_pipeline(ctx, n_rows: int, iters: int = 3) -> dict:
 
     builds_delta = snap("cylon_kernel_factory_builds_total") - b0
     waits = [tk.wait_s for tk in tickets if tk.wait_s is not None]
+    # the p95 queue wait via Histogram.quantile over the service wait
+    # histogram (bucket-interpolated — the same estimator the SLO
+    # tracker uses); the registry accumulates process-wide, but this
+    # is the only service phase of the bench run
+    wait_p95 = telemetry.REGISTRY.histogram(
+        "cylon_service_wait_seconds").quantile(0.95)
     world = max(ctx.get_world_size(), 1)
     return {
         "world": world,
@@ -613,6 +619,8 @@ def bench_service_pipeline(ctx, n_rows: int, iters: int = 3) -> dict:
         "compile_seconds_during_service": _sig(
             compile_seconds() - c0, 4),
         "mean_wait_s": _sig(sum(waits) / len(waits)) if waits else None,
+        "wait_p95_s": _sig(wait_p95, 4) if wait_p95 is not None
+        else None,
         "queries_per_s": _sig(N / svc_s, 4) if svc_s else 0.0,
     }
 

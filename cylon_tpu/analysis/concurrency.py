@@ -100,6 +100,28 @@ DECLARED_ENTRIES: Tuple[Tuple[str, str, str], ...] = (
     ("api", "resilience.inject", "arm"),
     ("api", "resilience.inject", "disarm"),
     ("api", "resilience.inject", "state"),
+    # the observability endpoint: ThreadingHTTPServer spawns one
+    # daemon thread PER REQUEST inside the stdlib (no syntactic
+    # Thread(...) for the scan to find), so the handler entry point
+    # and the route renderers are declared concurrency domains —
+    # scrapes race submitters, the worker, finalizers, everything
+    ("api", "service.obs_http", "_Handler.do_GET"),
+    ("api", "service.obs_http", "render_metrics"),
+    ("api", "service.obs_http", "render_healthz"),
+    ("api", "service.obs_http", "render_queries"),
+    ("api", "service.obs_http", "render_slo"),
+    ("api", "service.obs_http", "ObsServer.close"),
+    # the structured query log: fed by the root-span hook, read by
+    # scrape threads and test drivers
+    ("api", "telemetry.querylog", "recent"),
+    ("api", "telemetry.querylog", "enable"),
+    ("api", "telemetry.querylog", "disable"),
+    ("api", "telemetry.querylog", "lines_written"),
+    ("api", "telemetry.querylog", "reset"),
+    # the SLO tracker: observed from the hook domain, read by scrapes
+    ("api", "telemetry.slo", "observe"),
+    ("api", "telemetry.slo", "state"),
+    ("api", "telemetry.slo", "reset"),
 )
 
 # hook registrars: a function-valued argument to one of these becomes

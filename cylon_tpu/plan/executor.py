@@ -135,13 +135,22 @@ def execute(plan: ir.PlanNode, ctx=None, decision=None,
     and records — admission at dispatch time, against the live queue
     state) skips the internal admission pass but keeps its
     ``degrade_blocks`` lowering map; a pre-computed ``est`` map rides
-    along so the plan is not re-walked per dispatch."""
+    along so the plan is not re-walked per dispatch.
+
+    The whole run nests under ONE ``plan.query`` root span, same as
+    the analyzed path: every query — service or library mode — closes
+    exactly one root, which is what feeds the flight ring, the
+    structured query log (one digest per query), the per-tenant SLO
+    tracker, and the head-sampling decision. Shed/deadline raises
+    cross the root errored, so the forensic trail matches
+    ``execute_analyzed``."""
     rctx = _resolve_ctx(plan, ctx)
-    with _resil.query_deadline():
-        est, budget = _preflight(plan, rctx, est=est)
-        if decision is None:
-            decision = _admit(plan, rctx, est, budget)
-        return _Exec(ctx, degrade=decision.degrade_blocks).run(plan)
+    with _span("plan.query"):
+        with _resil.query_deadline():
+            est, budget = _preflight(plan, rctx, est=est)
+            if decision is None:
+                decision = _admit(plan, rctx, est, budget)
+            return _Exec(ctx, degrade=decision.degrade_blocks).run(plan)
 
 
 def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None,

@@ -13,6 +13,10 @@ optimization and re-hit the compiled-kernel memos.
   on the submitters' threads).
 * ``plancache`` — the structural plan fingerprint and the bounded LRU
   of optimized plans, shared between the service and library mode.
+* ``obs_http`` — the live operational surface: a stdlib HTTP endpoint
+  (``CYLON_OBS_PORT``) serving /metrics (Prometheus scrape), /healthz
+  (worker liveness + queue depths + pool watermarks), /queries (the
+  structured query-log ring) and /slo (per-tenant SLO state).
 
 Importing this package wires the plan cache into ``plan.lazy``'s
 late-bound optimize memo (the hook keeps plan/ from importing
@@ -28,7 +32,8 @@ Full semantics: docs/service.md.
 """
 from __future__ import annotations
 
-from . import plancache, scheduler
+from . import obs_http, plancache, scheduler
+from .obs_http import ObsServer
 from .plancache import PlanCache, fingerprint, global_cache
 from .scheduler import QueryService, QueryTicket
 
@@ -37,6 +42,7 @@ from .scheduler import QueryService, QueryTicket
 plancache.install()
 
 __all__ = [
-    "PlanCache", "QueryService", "QueryTicket", "fingerprint",
-    "global_cache", "plancache", "scheduler",
+    "ObsServer", "PlanCache", "QueryService", "QueryTicket",
+    "fingerprint", "global_cache", "obs_http", "plancache",
+    "scheduler",
 ]

@@ -186,6 +186,7 @@ class PlanCache:
                  ) -> Tuple[ir.PlanNode, PlanStats]:
         cap = cache_max()
         if cap <= 0 or _bypassed():
+            _set_last_event(None, "bypass")
             return _optimize(root, world)
         fp = fingerprint(root, world)
         with self._lock:
@@ -196,12 +197,14 @@ class PlanCache:
             out = self._rebind(fp, hit, root, world)
             if out is not None:
                 self._counter("hits").inc()
+                _set_last_event(fp, "hit")
                 return out
             # structural mismatch (defensive — the fingerprint covers
             # scan layout, so this means a corrupted entry): drop it
             # and fall through to a fresh optimize
             self.invalidate(fp)
         self._counter("misses").inc()
+        _set_last_event(fp, "miss")
         opt_root, stats = _optimize(root, world)
         with self._lock:
             self._entries[fp] = (_strip_template(opt_root), stats)
@@ -238,6 +241,29 @@ class PlanCache:
                 self.invalidate(fp)
                 raise
         return plan, _dc_replace(stats, notes=list(stats.notes))
+
+
+# per-thread record of the most recent optimize()'s cache fate —
+# (fingerprint, "hit" | "miss" | "bypass"). Thread-local, not global:
+# service submitters optimize concurrently, and each needs ITS query's
+# fate to stamp into the query-log digest (counter deltas would race).
+_last_event = threading.local()
+
+
+def _set_last_event(fp: Optional[str], cache: str) -> None:
+    _last_event.doc = {"plan_fp": fp, "plan_cache": cache}
+
+
+def last_event() -> Optional[dict]:
+    """The calling thread's most recent optimize() cache fate
+    (``{"plan_fp", "plan_cache"}``), or None — the scheduler reads it
+    right after ``query.optimized()`` on the submit thread and stamps
+    it onto the query's root attrs."""
+    return getattr(_last_event, "doc", None)
+
+
+def clear_last_event() -> None:
+    _last_event.doc = None
 
 
 # the process-global cache the library-mode memo and every

@@ -65,6 +65,7 @@ from ..telemetry import knobs as _knobs
 from ..telemetry import logger as _logger
 from ..telemetry import metrics as _metrics
 from ..telemetry import root_attrs as _root_attrs
+from . import plancache as _plancache
 
 DEFAULT_QUEUE_MAX = _knobs.default("CYLON_SERVICE_QUEUE_MAX")
 DEFAULT_QUANTUM_BYTES = _knobs.default("CYLON_SERVICE_QUANTUM_BYTES")
@@ -148,10 +149,11 @@ class QueryTicket:
 
 class _Job:
     __slots__ = ("ticket", "tenant", "root", "stats", "est", "cost",
-                 "ctx", "analyze", "deadline_s", "t_submit")
+                 "ctx", "analyze", "deadline_s", "t_submit",
+                 "cache_doc")
 
     def __init__(self, ticket, tenant, root, stats, est, cost, ctx,
-                 analyze, deadline_s):
+                 analyze, deadline_s, cache_doc=None):
         self.ticket = ticket
         self.tenant = tenant
         self.root = root
@@ -162,6 +164,10 @@ class _Job:
         self.analyze = analyze
         self.deadline_s = deadline_s
         self.t_submit = time.monotonic()
+        # plan-cache fate from the submit thread's optimize() —
+        # {"plan_fp", "plan_cache"} — stamped onto the query's root
+        # span for the structured query log
+        self.cache_doc = cache_doc or {}
 
 
 def _job_cost(est: dict, root: ir.PlanNode) -> int:
@@ -200,13 +206,18 @@ class QueryService:
         self._active: Optional[_Job] = None
         self._closed = False
         self._worker: Optional[threading.Thread] = None
+        self._obs = None               # obs_http.ObsServer when armed
         if start:
             self.start()
 
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> None:
-        """Start the executor worker (idempotent)."""
+        """Start the executor worker (idempotent) — and, when
+        ``CYLON_OBS_PORT`` is nonzero, the observability HTTP endpoint
+        (``service/obs_http.py``) serving this service's /metrics,
+        /healthz, /queries and /slo on a daemon thread."""
+        obs = None
         with self._cv:
             if self._worker is not None or self._closed:
                 return
@@ -214,6 +225,34 @@ class QueryService:
                 target=self._run, name=f"cylon-service-{self.name}",
                 daemon=True)
             self._worker.start()
+            port = _knobs.get("CYLON_OBS_PORT")
+            if port and self._obs is None:
+                from . import obs_http as _obs_http
+
+                obs = self._obs = _obs_http.ObsServer(service=self,
+                                                      port=port)
+        if obs is not None:
+            # bind+serve OUTSIDE the lock: a bad port must not wedge
+            # the scheduler, and the obs thread scrapes health() which
+            # takes this same lock
+            try:
+                obs.start()
+            except OSError:
+                _logger.exception(
+                    "service %s: observability endpoint failed to "
+                    "bind port %s — continuing without it",
+                    self.name, obs.requested_port)
+                with self._cv:
+                    self._obs = None
+                return
+            # a close() may have raced this start() and discarded the
+            # handle before the bind — it had nothing to stop then, so
+            # stop the now-live endpoint here or it outlives close()
+            with self._cv:
+                leaked = obs if self._closed or self._obs is not obs \
+                    else None
+            if leaked is not None:
+                leaked.close()
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Drain the remaining queue, stop the worker, reject further
@@ -226,6 +265,7 @@ class QueryService:
         with self._cv:
             self._closed = True
             worker = self._worker
+            obs, self._obs = self._obs, None
             if worker is None:
                 for t, q in self._queues.items():
                     orphans.extend(q)
@@ -241,6 +281,11 @@ class QueryService:
                 f"dispatched", code=Code.Invalid))
         if worker is not None:
             worker.join(timeout)
+        if obs is not None:
+            # after the worker: the endpoint stays scrapeable while
+            # the drain finishes, then shuts down with its thread
+            # joined (no leaked obs thread past close())
+            obs.close(timeout)
 
     def __enter__(self) -> "QueryService":
         self.start()
@@ -275,13 +320,18 @@ class QueryService:
         qid = next(_query_ids)
         ticket = QueryTicket(qid, tenant)
         # host-side prepare (no lock, no device work): optimize via the
-        # fingerprint cache + pre-flight estimates over the result
+        # fingerprint cache + pre-flight estimates over the result.
+        # The cache fate (fp, hit/miss) is read back thread-locally —
+        # this thread's optimize, not a racing submitter's — and rides
+        # the job into the query-log digest.
+        _plancache.clear_last_event()
         root, stats = query.optimized()
+        cache_doc = _plancache.last_event()
         est = preflight_estimates(root)
         cost = _job_cost(est, root)
         ctx = getattr(query, "context", None)
         job = _Job(ticket, tenant, root, stats, est, cost, ctx,
-                   analyze, deadline_s)
+                   analyze, deadline_s, cache_doc=cache_doc)
         with self._cv:
             if self._closed:
                 raise CylonPlanError(
@@ -338,6 +388,29 @@ class QueryService:
                 return self._depth
             q = self._queues.get(tenant)
             return len(q) if q is not None else 0
+
+    def health(self) -> dict:
+        """One lock-consistent liveness snapshot — the observability
+        endpoint's ``/healthz`` payload: worker liveness, total and
+        per-tenant queue depths, the in-flight query, dispatch
+        count."""
+        with self._cv:
+            worker = self._worker
+            active = self._active
+            doc = {
+                "service": self.name,
+                "closed": self._closed,
+                "worker_alive": worker is not None and
+                worker.is_alive(),
+                "queue_depth": self._depth,
+                "queue_depth_by_tenant": {
+                    t: len(q) for t, q in self._queues.items()},
+                "dispatched": self._dispatched,
+                "active": None if active is None else {
+                    "query_id": active.ticket.query_id,
+                    "tenant": active.tenant},
+            }
+        return doc
 
     # -- scheduling (deficit round-robin) -------------------------------
 
@@ -434,7 +507,10 @@ class QueryService:
         try:
             with _root_attrs(tenant=job.tenant,
                              query_id=ticket.query_id,
-                             service=self.name):
+                             service=self.name,
+                             wait_s=round(wait_s, 6),
+                             admission=decision.action,
+                             **job.cache_doc):
                 # inside root_attrs so the non-admit plan.admission
                 # marker span record() emits carries the tenant label
                 _admission.record(decision, tenant=job.tenant)

@@ -34,6 +34,19 @@ and grows it into a measurement layer:
   span trees plus, on any exception crossing a root span, a JSON
   crash dump (span stack, metrics snapshot, pool watermarks, ledger
   outstanding set) written to ``CYLON_FLIGHT_DIR``.
+* ``querylog`` — structured query log: one digest per completed root
+  query span (id, tenant, plan fingerprint, outcome, shuffle/retry/
+  HBM aggregates) in an in-memory ring + optional rotating JSONL
+  file — the join key between traces, metrics and crash dumps.
+* ``slo``     — per-tenant latency objectives: fixed-bucket latency
+  histograms with p50/p95/p99 estimation, error-budget accounting
+  (``CYLON_SLO_P95_MS`` / ``CYLON_SLO_TARGET``), burn events into the
+  flight admission ring.
+* ``sampling`` — overhead-bounded head sampling for root query spans
+  (``CYLON_TRACE_SAMPLE_RATE``, deterministic on the query-id hash):
+  sampled-out queries keep counters/histograms/querylog but skip
+  trace-sink writes; errored queries always promote to fully
+  recorded.
 
 The plan executor builds per-query EXPLAIN ANALYZE reports
 (plan/report.py) on this layer; docs/telemetry.md documents the span
@@ -55,8 +68,9 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       metrics_snapshot, record_host_sync, reset_metrics,
                       sample_memory, set_memory_pool, get_memory_pool)
 from .export import JsonlSpanSink, prometheus_text, span_to_json
-from . import knobs, ledger, profiler, skew
+from . import knobs, ledger, profiler, sampling, skew
 from . import flight
+from . import querylog, slo
 from .skew import SkewStats
 
 __all__ = [
@@ -73,6 +87,9 @@ __all__ = [
     "JsonlSpanSink", "prometheus_text", "span_to_json",
     # skew + compile-cost + memory-lifetime + failure observability
     "profiler", "skew", "SkewStats", "ledger", "flight",
+    # live-service observability: query digests, per-tenant SLOs,
+    # overhead-bounded trace sampling
+    "querylog", "slo", "sampling",
     # the declared CYLON_* environment-knob registry
     "knobs",
 ]

@@ -161,6 +161,16 @@ declare("CYLON_HBM_BYTES", 16 * (1 << 30), "int",
         "shuffle comm budget", lo=1)
 
 # telemetry/
+declare("CYLON_TRACE_SAMPLE_RATE", 1.0, "float",
+        "head-sampling rate for root query spans (0..1), decided "
+        "deterministically from the query_id hash; sampled-out queries "
+        "keep counters/histograms/querylog but skip trace-sink writes, "
+        "and errored queries are always promoted to fully recorded",
+        lo=0.0)
+declare("CYLON_SPAN_LOG_MAX_BYTES", 0, "int",
+        "size bound for file-backed JSONL sinks (span trace and query "
+        "log): past it the file rotates (keep-3 .1/.2/.3 suffixes); "
+        "0 = unbounded", lo=0)
 declare("CYLON_HBM_SPAN_ATTRS", True, "bool",
         "sample the registered MemoryPool at span enter/exit for "
         "hbm_delta/hbm_peak attrs; 0 skips the two per-span snapshots "
@@ -211,6 +221,21 @@ declare("CYLON_SERVICE_QUANTUM_BYTES", 1 << 20, "int",
         "fair-share byte unit)", lo=1)
 declare("CYLON_PLAN_CACHE_MAX", 64, "int",
         "plan/fingerprint cache entries (0 disables the cache)", lo=0)
+declare("CYLON_OBS_PORT", 0, "int",
+        "TCP port for the observability HTTP endpoint (/metrics, "
+        "/healthz, /queries, /slo) the QueryService starts on a "
+        "daemon thread; 0 disables it", lo=0)
+
+# telemetry/slo.py (per-tenant service-level objectives)
+declare("CYLON_SLO_P95_MS", None, "float",
+        "declared per-tenant latency objective: the p95 query latency "
+        "(ms) the service promises; unset = no objective, SLO "
+        "evaluation reports latency quantiles only", lo=0.0)
+declare("CYLON_SLO_TARGET", 0.99, "float",
+        "fraction of queries that must meet the latency objective "
+        "(the SLO target); the error budget is the allowed 1-target "
+        "violation share, and burn events land in the flight "
+        "admission ring", lo=0.0)
 
 
 if __name__ == "__main__":  # pragma: no cover - doc regeneration

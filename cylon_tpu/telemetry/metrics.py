@@ -126,6 +126,33 @@ class Histogram:
                     "min": self.min, "max": self.max,
                     "counts": list(self.counts)}
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimate the q-quantile (0..1) by linear interpolation
+        WITHIN the bucket holding the target rank (the
+        histogram_quantile estimator): the bucket's observations are
+        assumed uniform over (lower, upper]. The first bucket
+        interpolates from ``min`` (0 when unknown), the +Inf bucket
+        cannot interpolate and reports ``max``. Returns None on an
+        empty histogram. Reads the count group under the per-metric
+        RLock, so a concurrent observe() never tears the estimate."""
+        st = self.stats()
+        if st["count"] == 0:
+            return None
+        if q <= 0.0:
+            return st["min"]
+        if q >= 1.0:
+            return st["max"]
+        rank = q * st["count"]
+        cum = 0
+        lo = st["min"] if st["min"] is not None else 0.0
+        for bound, c in zip(self.buckets, st["counts"]):
+            if cum + c >= rank and c > 0:
+                lo_eff = min(lo, bound)
+                return lo_eff + (bound - lo_eff) * (rank - cum) / c
+            cum += c
+            lo = bound
+        return st["max"]
+
 
 def _series_key(name: str, labels: Optional[Dict[str, str]]) -> tuple:
     return name, tuple(sorted((labels or {}).items()))
@@ -173,10 +200,15 @@ class MetricsRegistry:
         return self._get(Histogram, name, labels, buckets=buckets)
 
     def series(self):
-        """Sorted [(name, labels, metric)] — the exporters' view."""
+        """Sorted [(name, labels, metric)] — the exporters' view.
+        Materialized under the registry lock: a concurrent scrape
+        (the obs endpoint's /metrics) must never iterate the metric
+        dict while a submitter thread is registering a new series
+        (RuntimeError: dict changed size during iteration)."""
+        with self._lock:
+            items = list(self._metrics.items())
         return [(n, l, m)
-                for (n, l), m in sorted(self._metrics.items(),
-                                        key=lambda kv: kv[0])]
+                for (n, l), m in sorted(items, key=lambda kv: kv[0])]
 
     def snapshot(self) -> dict:
         """Plain JSON-able dict keyed by the rendered series name —

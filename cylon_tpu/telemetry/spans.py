@@ -39,7 +39,7 @@ from __future__ import annotations
 import itertools
 import logging
 import time
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from contextvars import ContextVar
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional
@@ -48,6 +48,7 @@ import jax
 
 from . import knobs as _knobs
 from . import metrics as _metrics
+from . import sampling as _sampling
 
 logger = logging.getLogger("cylon_tpu")
 
@@ -114,6 +115,11 @@ class Span:
     root_id: int = 0               # the enclosing tree's root span_id
     elapsed_ms: Optional[float] = None
     error: bool = False
+    # head-sampling decision (telemetry/sampling.py): decided at the
+    # ROOT from the query_id hash, inherited by every child. False =
+    # this span skips trace sinks + device-trace annotation; the tree
+    # itself is still built (crash dumps / error promotion need it)
+    sampled: bool = True
     _t0: float = 0.0
     _hbm0: Optional[int] = None    # pool bytes_in_use at span enter
 
@@ -131,6 +137,14 @@ class Span:
         yield self
         for c in self.children:
             yield from c.walk()
+
+    def walk_postorder(self) -> Iterator["Span"]:
+        """Children before parents — the order spans CLOSE in, and the
+        order the JSONL exporter promises its lines (error promotion
+        replays a sampled-out tree through the sinks in this order)."""
+        for c in self.children:
+            yield from c.walk_postorder()
+        yield self
 
     def to_dict(self, nested: bool = False) -> dict:
         """Flat JSON-able record (parent_id links the tree); pass
@@ -202,6 +216,14 @@ def remove_sink(sink: Callable) -> None:
             break
 
 
+def _emit_to_sinks(s: "Span") -> None:
+    for sink in list(_sinks):
+        try:
+            sink(s)
+        except Exception:  # pragma: no cover - defensive
+            logger.exception("span sink failed")
+
+
 class collect_phases:
     """Collect every span label entered inside the context — the
     programmatic mirror of the INFO log stream. ``count(prefix)``
@@ -252,12 +274,24 @@ def span(name: str, seq: Optional[int] = None, **attrs) -> Iterator[Span]:
     ``s.set(rows_out=...)``. Exceptions re-raise after the span records
     ``error=True`` and its elapsed time (the fixed phase() bug)."""
     parent = _current.get()
+    sid = next(_span_ids)
     if parent is None:
         ra = _root_attrs.get()
         if ra:
             attrs = {**ra, **attrs}
-    s = Span(name, seq, dict(attrs), span_id=next(_span_ids),
-             parent_id=parent.span_id if parent is not None else 0)
+        # head sampling decided HERE, once per tree: deterministic on
+        # the stamped query_id (the service scheduler's monotonic id;
+        # this root's span_id outside the service — replayable either
+        # way, never an RNG)
+        sampled = _sampling.decide(attrs.get("query_id", sid))
+        _sampling.record_decision(sampled)
+        if not sampled:
+            attrs = {**attrs, "sampled": False}
+    else:
+        sampled = parent.sampled
+    s = Span(name, seq, dict(attrs), span_id=sid,
+             parent_id=parent.span_id if parent is not None else 0,
+             sampled=sampled)
     s.root_id = parent.root_id if parent is not None else s.span_id
     label = s.label
     for c in _collectors:
@@ -279,7 +313,11 @@ def span(name: str, seq: Optional[int] = None, **attrs) -> Iterator[Span]:
     token = _current.set(s)
     s._t0 = time.perf_counter()
     try:
-        with jax.profiler.TraceAnnotation(f"cylon:{label}"):
+        # sampled-out trees skip the device-trace annotation too — the
+        # Perfetto label volume is part of the per-span cost the head
+        # decision bounds
+        with jax.profiler.TraceAnnotation(f"cylon:{label}") \
+                if s.sampled else nullcontext():
             yield s
     except BaseException:
         s.error = True
@@ -296,12 +334,26 @@ def span(name: str, seq: Optional[int] = None, **attrs) -> Iterator[Span]:
             except Exception:  # pragma: no cover - defensive  # cylint: disable=errors/broad-swallow — pool snapshot failure drops hbm attrs
                 pass
         _metrics.observe_phase(s.name, s.elapsed_ms, error=s.error)
-        for sink in list(_sinks):
-            try:
-                sink(s)
-            except Exception:  # pragma: no cover - defensive
-                logger.exception("span sink failed")
+        if s.sampled:
+            _emit_to_sinks(s)
         if parent is None:
+            if s.error and not s.sampled:
+                # error promotion: the whole tree is complete (children
+                # closed first) and still in memory — record it to the
+                # sinks post-hoc, children before parents, so the JSONL
+                # trace AND the crash dump read like a fully sampled
+                # query. Forensics never degrade under sampling.
+                s.sampled = True
+                # the sampled attr means "a full trace was exported":
+                # after promotion that is TRUE — the query log's
+                # digest must not tell an operator that the one class
+                # of query GUARANTEED to have a trace has none
+                s.attrs["sampled"] = True
+                s.attrs["sampled_promoted"] = True
+                _sampling.record_promotion()
+                for node in s.walk_postorder():
+                    node.sampled = True
+                    _emit_to_sinks(node)
             for hook in list(_root_hooks):
                 try:
                     hook(s)

@@ -50,7 +50,11 @@ DEFAULT_THRESHOLD = 0.2
 # capacity-bucketing work (specialization analysis, docs/analysis.md)
 # drives DOWN — a round that halves it must not trip the gate, and a
 # round that rebloats it past the threshold must.
-LOWER_IS_BETTER = {"compile.distinct_kernel_signatures"}
+LOWER_IS_BETTER = {"compile.distinct_kernel_signatures",
+                   # p95 submit→dispatch queue wait of the service
+                   # pipeline (seconds): a rise is a scheduling/latency
+                   # regression, a drop is the win
+                   "service_pipeline.wait_p95_s"}
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -119,7 +123,8 @@ def flatten_metrics(parsed: Optional[dict]) -> Dict[str, float]:
                             ("join_rows_per_s", "join_rows_per_s"),
                             ("groupby_rows_per_s", "groupby_rows_per_s"),
                             ("cache_hits", "cache_hits"),
-                            ("queries_per_s", "queries_per_s")):
+                            ("queries_per_s", "queries_per_s"),
+                            ("wait_p95_s", "wait_p95_s")):
             v = _num(cfg.get(src))
             if v is not None:
                 out[f"{name}.{suffix}"] = v

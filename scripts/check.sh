@@ -81,6 +81,17 @@ echo "== service smoke: scripts/smoke_service.py =="
 # zero ledger leaks
 python scripts/smoke_service.py
 
+echo "== observability smoke: scripts/smoke_obs.py =="
+# the live service observatory: a multi-tenant service with the HTTP
+# endpoint armed must serve valid /metrics (incl. per-tenant
+# cylon_slo_latency_p95_ms series), /healthz, /queries and /slo while
+# running; the structured query log must carry exactly one parseable
+# JSONL line per completed query; at CYLON_TRACE_SAMPLE_RATE=0.5 the
+# span-sink line count must DROP while counters/querylog stay complete
+# and the per-query sampling decisions replay from the query_id hash;
+# close() must leave no obs thread and zero ledger leaks
+python scripts/smoke_obs.py
+
 echo "== chaos drill: scripts/chaos.py --seeds 3 =="
 # seeded fault plans through the bench pipeline: transient faults must
 # retry to success ([RETRY] in EXPLAIN ANALYZE), persistent faults must

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Observability-endpoint smoke gate (wired into scripts/check.sh).
+
+Drives the live service observatory end to end on the virtual CPU
+mesh — two tenants x 8 queries per phase through a QueryService with
+the HTTP endpoint armed (CYLON_OBS_PORT) — and verifies the
+acceptance bar of the observability tier:
+
+* **live scrape** — /metrics returns valid Prometheus text carrying
+  the per-tenant query counters AND the per-tenant
+  ``cylon_slo_latency_p95_ms`` series; /healthz reports a live worker
+  (HTTP 200); /queries returns the digest ring; /slo returns
+  per-tenant quantiles + error budget.
+* **structured query log complete** — the JSONL query log carries
+  exactly one parseable line per completed query, every line naming
+  tenant, plan fingerprint, cache fate, admission decision and wait.
+* **sampling bounds traces, not signals** — a second phase runs with
+  ``CYLON_TRACE_SAMPLE_RATE=0.5``: the span-sink line count DROPS
+  versus the fully-sampled phase while the querylog line count and
+  ``cylon_queries_total`` stay complete, and the per-digest
+  ``sampled`` flags match ``sampling.decide(query_id)`` exactly
+  (the deterministic replayable head decision).
+* **clean shutdown** — after ``svc.close()`` no ``cylon-obs`` thread
+  survives (the concurrency domain sweep stays accurate) and the
+  ledger reports zero leaks.
+
+Exit 0 on success; any failure prints the offending artifact and
+exits non-zero, failing the gate.
+"""
+import json
+import os
+import socket
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+os.environ["CYLON_TPU_VERIFY_PLANS"] = "1"
+# a generous objective: the SLO machinery must be LIVE (budget gauges,
+# /slo payload) without this smoke's wall clock deciding pass/fail
+os.environ["CYLON_SLO_P95_MS"] = "60000"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_PER_PHASE = 16  # 2 tenants x 8 queries
+TENANTS = ("tenant-a", "tenant-b")
+
+
+def fail(msg: str) -> None:
+    print(f"obs smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main() -> None:
+    import gc
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu import plan, telemetry
+    from cylon_tpu.service import QueryService
+    from cylon_tpu.telemetry import ledger, querylog, sampling
+
+    port = free_port()
+    os.environ["CYLON_OBS_PORT"] = str(port)
+
+    ctx = ct.CylonContext.InitDistributed(ct.TPUConfig(world_size=4))
+    n = 2048
+
+    def tables(seed):
+        r = np.random.default_rng(seed)
+        left = ct.Table.from_pydict(ctx, {
+            "k": r.integers(0, n // 4, n).astype(np.int32),
+            "v": r.normal(size=n).astype(np.float32)})
+        right = ct.Table.from_pydict(ctx, {
+            "k": r.integers(0, n // 4, n).astype(np.int32),
+            "w": r.normal(size=n).astype(np.float32)})
+        return left, right
+
+    tabs = {t: tables(100 + i) for i, t in enumerate(TENANTS)}
+
+    def pipe(t):
+        left, right = tabs[t]
+        return plan.scan(left).join(plan.scan(right), on="k") \
+            .groupby("lt-1", ["rt-2"], ["sum"])
+
+    def get(route):
+        url = f"http://127.0.0.1:{port}{route}"
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, resp.read().decode("utf-8")
+
+    def counter_sum(prefix):
+        return sum(v for k, v in telemetry.metrics_snapshot().items()
+                   if k.startswith(prefix) and isinstance(v, int))
+
+    # warm the kernel memos + plan cache so both phases are steady-state
+    pipe(TENANTS[0]).execute()
+
+    tmp = tempfile.mkdtemp(prefix="cylon-obs-smoke-")
+    qlog_path = os.path.join(tmp, "querylog.jsonl")
+    querylog.enable(qlog_path)
+
+    def run_phase(svc, trace_path):
+        tickets = []
+        with telemetry.JsonlSpanSink(trace_path) as sink:
+            for i in range(N_PER_PHASE):
+                t = TENANTS[i % 2]
+                tickets.append(svc.submit(pipe(t), tenant=t))
+            svc.drain(timeout=600)
+            for tk in tickets:
+                if tk.outcome != "ok":
+                    fail(f"query {tk.query_id} outcome {tk.outcome!r}")
+                tk.result(timeout=60)
+        return tickets, sink.spans_written
+
+    svc = QueryService(name="obs-smoke")
+
+    # -- phase A: fully sampled -------------------------------------------
+    os.environ["CYLON_TRACE_SAMPLE_RATE"] = "1.0"
+    ok0 = counter_sum("cylon_queries_total")
+    lines0 = querylog.lines_written()
+    tickets_a, trace_lines_a = run_phase(
+        svc, os.path.join(tmp, "trace_full.jsonl"))
+    if querylog.lines_written() - lines0 != N_PER_PHASE:
+        fail(f"querylog wrote {querylog.lines_written() - lines0} "
+             f"lines for {N_PER_PHASE} completed queries (phase A)")
+
+    # -- live scrape against the running service --------------------------
+    status, prom = get("/metrics")
+    if status != 200:
+        fail(f"/metrics status {status}")
+    for t in TENANTS:
+        if not any(l.startswith("cylon_queries_total") and
+                   f'tenant="{t}"' in l and 'outcome="ok"' in l
+                   for l in prom.splitlines()):
+            fail(f"cylon_queries_total{{tenant={t},outcome=ok}} "
+                 f"missing from /metrics")
+        if not any(l.startswith("cylon_slo_latency_p95_ms") and
+                   f'tenant="{t}"' in l for l in prom.splitlines()):
+            fail(f"cylon_slo_latency_p95_ms{{tenant={t}}} missing "
+                 f"from /metrics")
+    if "cylon_trace_sampled_total" not in prom:
+        fail("cylon_trace_sampled_total missing from /metrics")
+
+    status, hz = get("/healthz")
+    hz = json.loads(hz)
+    if status != 200 or not hz["ok"] or not \
+            hz["service"]["worker_alive"]:
+        fail(f"/healthz not live: {status} {hz}")
+
+    status, q = get("/queries")
+    digests = json.loads(q)
+    if status != 200 or len(digests) < N_PER_PHASE:
+        fail(f"/queries returned {len(digests)} digests "
+             f"(want >= {N_PER_PHASE})")
+    d = digests[-1]
+    for field in ("query_id", "tenant", "plan_fp", "plan_cache",
+                  "outcome", "exec_ms", "wait_s", "admission",
+                  "shuffle_bytes"):
+        if d.get(field) is None:
+            fail(f"digest field {field!r} missing/None: {d}")
+
+    status, slo_doc = get("/slo")
+    slo_doc = json.loads(slo_doc)
+    for t in TENANTS:
+        st = slo_doc.get(t)
+        if status != 200 or st is None:
+            fail(f"/slo missing tenant {t}: {slo_doc}")
+        if st["p95_ms"] is None or st["error_budget_remaining"] is None:
+            fail(f"/slo incomplete for {t}: {st}")
+
+    # -- phase B: half sampled — traces drop, signals stay complete -------
+    os.environ["CYLON_TRACE_SAMPLE_RATE"] = "0.5"
+    lines1 = querylog.lines_written()
+    tickets_b, trace_lines_b = run_phase(
+        svc, os.path.join(tmp, "trace_half.jsonl"))
+    if querylog.lines_written() - lines1 != N_PER_PHASE:
+        fail(f"querylog incomplete under sampling: "
+             f"{querylog.lines_written() - lines1} != {N_PER_PHASE}")
+    if counter_sum("cylon_queries_total") - ok0 != 2 * N_PER_PHASE:
+        fail("cylon_queries_total incomplete under sampling")
+    if trace_lines_b >= trace_lines_a:
+        fail(f"span-sink line count did not drop under 0.5 sampling: "
+             f"{trace_lines_b} >= {trace_lines_a}")
+    # the head decision is deterministic and replayable: the digests'
+    # sampled flags must match sampling.decide(query_id) exactly
+    want = {tk.query_id: sampling.decide(tk.query_id, 0.5)
+            for tk in tickets_b}
+    got = {d["query_id"]: d["sampled"]
+           for d in querylog.recent() if d["query_id"] in want}
+    if got != want:
+        fail(f"sampling decisions diverge from decide(query_id): "
+             f"want {want}, got {got}")
+    if all(want.values()):
+        fail("degenerate phase B: every query sampled in — "
+             "line-drop assertion proved nothing")
+
+    # every query-log line is independently parseable
+    with open(qlog_path, encoding="utf-8") as f:
+        parsed = [json.loads(line) for line in f]
+    if len(parsed) != 2 * N_PER_PHASE:
+        fail(f"querylog file has {len(parsed)} lines, want "
+             f"{2 * N_PER_PHASE}")
+
+    # -- clean shutdown ---------------------------------------------------
+    svc.close()
+    querylog.disable()
+    if any(th.name == "cylon-obs" for th in threading.enumerate()):
+        fail("obs endpoint thread leaked past svc.close()")
+    try:
+        get("/healthz")
+    except OSError:
+        pass
+    else:
+        fail("endpoint still serving after close()")
+
+    del tickets_a, tickets_b, d, digests
+    gc.collect()
+    if ledger.leak_count() != 0:
+        fail(f"ledger leaks: "
+             f"{ledger.outstanding(include_borrowed=False)}")
+
+    sampled_out = sum(1 for v in want.values() if not v)
+    print(f"obs smoke: OK — {2 * N_PER_PHASE} queries over "
+          f"{len(TENANTS)} tenants, scraped /metrics /healthz "
+          f"/queries /slo live, querylog complete "
+          f"({2 * N_PER_PHASE} lines), trace lines "
+          f"{trace_lines_a} -> {trace_lines_b} at rate 0.5 "
+          f"({sampled_out}/{N_PER_PHASE} sampled out), "
+          f"endpoint shut down clean, zero leaks")
+
+
+if __name__ == "__main__":
+    main()

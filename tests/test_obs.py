@@ -1,0 +1,528 @@
+"""Live-observatory tests: the scrape endpoint under concurrent load,
+the structured query log, per-tenant SLO math, deterministic trace
+sampling with error promotion, span-sink rotation, and
+Histogram.quantile pins."""
+import gc
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import plan, telemetry
+from cylon_tpu.resilience import inject
+from cylon_tpu.service import ObsServer, plancache
+from cylon_tpu.service.obs_http import (render_healthz, render_queries,
+                                        render_slo)
+from cylon_tpu.service.scheduler import QueryService
+from cylon_tpu.telemetry import flight, ledger, querylog, sampling, slo
+from cylon_tpu.telemetry.export import RotatingJsonlWriter
+from cylon_tpu.telemetry.metrics import Histogram
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    inject.disarm()
+    plancache.global_cache().clear()
+    querylog.reset()
+    slo.reset()
+
+
+def _tables(ctx, n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    left = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, max(n // 4, 1), n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32)})
+    right = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, max(n // 4, 1), n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32)})
+    return left, right
+
+
+def _pipe(left, right):
+    return plan.scan(left).join(plan.scan(right), on="k") \
+        .groupby("lt-1", ["rt-2"], ["sum"])
+
+
+def _get(obs, route):
+    with urllib.request.urlopen(obs.url(route), timeout=30) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# Histogram.quantile — exact pins on a synthetic distribution
+# ---------------------------------------------------------------------------
+
+
+def test_quantile_pins_linear_interpolation():
+    h = Histogram(buckets=(10.0, 20.0, 30.0, 40.0))
+    for v in range(1, 41):          # 1..40, ten per bucket
+        h.observe(float(v))
+    # rank q*count lands mid-bucket; uniform-within-bucket => exact
+    assert h.quantile(0.5) == 20.0
+    assert h.quantile(0.95) == 38.0
+    assert h.quantile(0.75) == 30.0
+    # boundaries: min/max short-circuit
+    assert h.quantile(0.0) == 1.0
+    assert h.quantile(1.0) == 40.0
+
+
+def test_quantile_first_bucket_interpolates_from_min():
+    h = Histogram(buckets=(10.0, 20.0))
+    for v in (4.0, 6.0, 8.0, 10.0):
+        h.observe(v)
+    # rank 2 of 4 in bucket (min=4, 10]: 4 + (10-4)*2/4 = 7.0
+    assert h.quantile(0.5) == 7.0
+
+
+def test_quantile_inf_bucket_reports_max_and_empty_none():
+    h = Histogram(buckets=(10.0,))
+    assert h.quantile(0.5) is None
+    h.observe(100.0)
+    h.observe(200.0)
+    assert h.quantile(0.99) == 200.0
+
+
+# ---------------------------------------------------------------------------
+# deterministic head sampling
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_fraction_is_process_independent():
+    """The decision is a pure sha256 of the query id: identical under
+    different PYTHONHASHSEEDs / processes (no seed-randomized hash(),
+    no RNG). The subprocesses load sampling.py standalone (it is a
+    stdlib-only leaf) so the check costs no jax import."""
+    mod_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "cylon_tpu", "telemetry", "sampling.py")
+    code = (
+        "import hashlib\n"
+        "src = open(%r).read()\n"
+        "ns = {'hashlib': hashlib}\n"
+        "start = src.index('def fraction')\n"
+        "end = src.index('def decide')\n"
+        "exec(src[start:end], ns)\n"
+        "print([round(ns['fraction'](i), 12) for i in range(20)])\n"
+        % mod_path)
+    outs = set()
+    for seed in ("0", "271828"):
+        env = dict(os.environ, PYTHONHASHSEED=seed)
+        outs.add(subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, check=True).stdout)
+    assert len(outs) == 1
+    # and in-process agrees with the subprocesses
+    got = str([round(sampling.fraction(i), 12) for i in range(20)])
+    assert outs.pop().strip() == got
+
+
+def test_sampling_rate_edges():
+    assert sampling.decide(123, 1.0) is True
+    assert sampling.decide(123, 0.0) is False
+    # the decision at 0.5 is fixed by the hash, never by call count
+    first = sampling.decide(123, 0.5)
+    assert all(sampling.decide(123, 0.5) is first for _ in range(5))
+
+
+def test_sampled_out_query_keeps_signals_drops_trace(dist_ctx,
+                                                     monkeypatch):
+    """CYLON_TRACE_SAMPLE_RATE=0: no JSONL lines, but the phase
+    histograms, the query digest and the flight ring stay complete."""
+    monkeypatch.setenv("CYLON_TRACE_SAMPLE_RATE", "0")
+    left, right = _tables(dist_ctx, seed=5)
+    querylog.reset()
+    flight.reset()
+    import io
+
+    buf = io.StringIO()
+    snap0 = telemetry.metrics_snapshot().get(
+        'cylon_phase_latency_ms{phase="plan.query"}',
+        {"count": 0})["count"]
+    with telemetry.JsonlSpanSink(buf):
+        _pipe(left, right).execute()
+    assert buf.getvalue() == ""            # trace fully suppressed
+    snap1 = telemetry.metrics_snapshot()[
+        'cylon_phase_latency_ms{phase="plan.query"}']["count"]
+    assert snap1 == snap0 + 1              # histograms complete
+    digests = querylog.recent()
+    assert digests and digests[-1]["outcome"] == "ok"
+    assert digests[-1]["sampled"] is False
+    assert digests[-1]["shuffle_bytes"] > 0   # tree still walked
+    ring = [s for s in flight.recent() if s.name == "plan.query"]
+    assert ring and ring[-1].attrs.get("sampled") is False
+
+
+def test_error_promotion_full_crash_dump(dist_ctx, tmp_path,
+                                         monkeypatch):
+    """A sampled-OUT query that fails is promoted to fully recorded:
+    the crash dump carries the complete span tree and the sinks
+    receive the promoted spans (children before parents)."""
+    monkeypatch.setenv("CYLON_TRACE_SAMPLE_RATE", "0")
+    monkeypatch.setenv("CYLON_FLIGHT_DIR", str(tmp_path))
+    left, right = _tables(dist_ctx, seed=6)
+    import io
+
+    buf = io.StringIO()
+    inject.arm("exchange:1+:transient")
+    try:
+        with telemetry.JsonlSpanSink(buf):
+            with pytest.raises(ct.CylonTransientError):
+                _pipe(left, right).execute()
+    finally:
+        inject.disarm()
+    dumps = glob.glob(str(tmp_path / "*.json"))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert doc["root_label"] == "plan.query"
+    assert doc["query"]["children"]          # FULL tree, not a stub
+    assert doc["query"]["attrs"].get("sampled_promoted") is True
+    # the promoted trace reached the sinks, children before parents
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert lines and lines[-1]["name"] == "plan.query"
+    assert len(lines) > 1
+    by_id = {l["span_id"]: i for i, l in enumerate(lines)}
+    for l in lines:
+        if l["parent_id"]:
+            assert by_id[l["parent_id"]] > by_id[l["span_id"]]
+    # the digest's sampled field means "a full trace was exported" —
+    # TRUE after promotion (an operator triaging via /queries must
+    # never be told the one query class guaranteed to have a trace
+    # has none); sampled_promoted records that it was a late recording
+    d = querylog.recent()[-1]
+    assert d["outcome"] == "error"
+    assert d["sampled"] is True
+    assert d["sampled_promoted"] is True
+    assert telemetry.metrics_snapshot().get(
+        "cylon_trace_promotions_total", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# structured query log
+# ---------------------------------------------------------------------------
+
+
+def test_querylog_one_digest_per_query_with_join_keys(dist_ctx,
+                                                      tmp_path):
+    """Every completed query — service or library mode — logs exactly
+    one digest carrying the trace/metrics/cache join keys."""
+    left, right = _tables(dist_ctx, seed=7)
+    qlog = str(tmp_path / "q.jsonl")
+    querylog.enable(qlog)
+    try:
+        querylog.reset()
+        n0 = querylog.lines_written()
+        _pipe(left, right).execute()        # library mode
+        svc = QueryService(name="qlog-test", start=False)
+        tk = svc.submit(_pipe(left, right), tenant="acme")
+        svc.drain(timeout=600)
+        tk.result(timeout=60)
+        svc.close()
+        assert querylog.lines_written() - n0 == 2
+        lines = [json.loads(l) for l in open(qlog)][-2:]
+        lib, served = lines
+        assert lib["tenant"] == "default" and lib["wait_s"] is None
+        assert served["tenant"] == "acme"
+        assert served["query_id"] == tk.query_id
+        assert served["service"] == "qlog-test"
+        assert served["wait_s"] is not None
+        assert served["admission"] == "admit"
+        assert served["plan_cache"] in ("hit", "miss")
+        assert served["plan_fp"] == plancache.fingerprint(
+            _pipe(left, right)._node, 4)
+        assert served["outcome"] == "ok"
+        assert served["exec_ms"] > 0
+        assert served["shuffles"] >= 1
+        assert served["shuffle_bytes"] > 0
+        assert served["shuffle_rows"] > 0
+    finally:
+        querylog.disable()
+
+
+def test_querylog_ring_is_bounded(dist_ctx, monkeypatch):
+    monkeypatch.setenv("CYLON_FLIGHT_RING", "2")
+    querylog.reset()                         # re-reads the knob
+    for i in range(querylog.RING_FACTOR * 2 + 3):
+        with telemetry.span("plan.query", query_id=i):
+            pass
+    recent = querylog.recent()
+    assert len(recent) == querylog.RING_FACTOR * 2
+    assert recent[-1]["query_id"] == querylog.RING_FACTOR * 2 + 2
+
+
+def test_querylog_ignores_non_query_roots():
+    querylog.reset()
+    with telemetry.span("distributed_join", seq=1):
+        pass
+    with telemetry.span("plan.preflight"):
+        pass
+    assert querylog.recent() == []
+
+
+# ---------------------------------------------------------------------------
+# per-tenant SLO math
+# ---------------------------------------------------------------------------
+
+
+def test_slo_budget_math_pins(monkeypatch):
+    monkeypatch.setenv("CYLON_SLO_P95_MS", "100")
+    monkeypatch.setenv("CYLON_SLO_TARGET", "0.9")
+    slo.reset()
+    telemetry.reset_metrics()
+    # 20 queries, 2 violations (one slow, one error): allowed = 2,
+    # budget fully burned; a 3rd violation clamps at 0
+    for _ in range(17):
+        slo.observe("t1", 50.0)
+    slo.observe("t1", 500.0)                 # latency violation
+    slo.observe("t1", 50.0, error=True)      # error violation
+    slo.observe("t1", 50.0)
+    st = slo.state()["t1"]
+    assert st["count"] == 20
+    assert st["violations"] == 2
+    assert st["error_budget_remaining"] == 0.0
+    assert st["objective_p95_ms"] == 100.0
+    assert st["burn_events"] == 2
+    # burn events landed in the flight admission ring
+    burns = [a for a in flight.admissions()
+             if a.get("action") == "slo_burn" and a["tenant"] == "t1"]
+    assert len(burns) >= 2
+    assert burns[-1]["objective_p95_ms"] == 100.0
+    # half the allowance: 1 violation in 20 at target 0.9 -> 0.5 left
+    assert slo.error_budget_remaining(20, 1, t=0.9) == \
+        pytest.approx(0.5)
+    assert slo.error_budget_remaining(0, 0) == 1.0
+    # target 1.0: binary budget
+    assert slo.error_budget_remaining(10, 0, t=1.0) == 1.0
+    assert slo.error_budget_remaining(10, 1, t=1.0) == 0.0
+
+
+def test_slo_gauges_exported_per_tenant(monkeypatch):
+    monkeypatch.setenv("CYLON_SLO_P95_MS", "1000")
+    slo.reset()
+    for v in (10.0, 20.0, 30.0):
+        slo.observe("gauge-t", v)
+    snap = telemetry.metrics_snapshot()
+    assert snap['cylon_slo_latency_p95_ms{tenant="gauge-t"}'] > 0
+    assert snap[
+        'cylon_slo_error_budget_remaining{tenant="gauge-t"}'] == 1.0
+    prom = telemetry.prometheus_text()
+    assert 'cylon_slo_latency_p95_ms{tenant="gauge-t"}' in prom
+
+
+def test_slo_no_objective_reports_quantiles_only(monkeypatch):
+    monkeypatch.delenv("CYLON_SLO_P95_MS", raising=False)
+    slo.reset()
+    slo.observe("quiet-t", 42.0)
+    st = slo.state()["quiet-t"]
+    assert st["p95_ms"] is not None
+    assert st["error_budget_remaining"] is None
+    assert st["violations"] is None
+
+
+# ---------------------------------------------------------------------------
+# the observability endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_endpoint_routes_and_payloads(dist_ctx, monkeypatch):
+    monkeypatch.setenv("CYLON_SLO_P95_MS", "60000")
+    left, right = _tables(dist_ctx, seed=9)
+    querylog.reset()
+    svc = QueryService(name="obs-test")
+    obs = ObsServer(service=svc, port=0).start()
+    try:
+        tk = svc.submit(_pipe(left, right), tenant="route-t")
+        svc.drain(timeout=600)
+        tk.result(timeout=60)
+        status, prom = _get(obs, "/metrics")
+        assert status == 200
+        assert "# TYPE cylon_phase_latency_ms histogram" in prom
+        assert any(l.startswith("cylon_slo_latency_p95_ms")
+                   and 'tenant="route-t"' in l
+                   for l in prom.splitlines())
+        status, hz = _get(obs, "/healthz")
+        hz = json.loads(hz)
+        assert status == 200 and hz["ok"]
+        assert hz["service"]["worker_alive"] is True
+        assert hz["service"]["queue_depth"] == 0
+        status, q = _get(obs, "/queries")
+        digests = json.loads(q)
+        assert status == 200
+        assert any(d["tenant"] == "route-t" for d in digests)
+        status, s = _get(obs, "/slo")
+        assert status == 200 and "route-t" in json.loads(s)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(obs, "/nope")
+        assert ei.value.code == 404
+    finally:
+        obs.close()
+        svc.close()
+    assert not any(t.name == "cylon-obs"
+                   for t in threading.enumerate())
+
+
+def test_healthz_503_after_close(dist_ctx):
+    svc = QueryService(name="dead-test")
+    obs = ObsServer(service=svc, port=0).start()
+    try:
+        svc.close()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(obs, "/healthz")
+        assert ei.value.code == 503
+    finally:
+        obs.close()
+
+
+def test_service_arms_endpoint_from_knob(dist_ctx, monkeypatch):
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    monkeypatch.setenv("CYLON_OBS_PORT", str(port))
+    svc = QueryService(name="knob-test")
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+            assert json.loads(r.read())["ok"] is True
+    finally:
+        svc.close()
+    # close() tears the endpoint down with the worker
+    with pytest.raises(OSError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5)
+
+
+def test_endpoint_disabled_at_port_zero(dist_ctx, monkeypatch):
+    monkeypatch.setenv("CYLON_OBS_PORT", "0")
+    svc = QueryService(name="noobs-test")
+    try:
+        assert svc._obs is None
+        assert not any(t.name == "cylon-obs"
+                       for t in threading.enumerate())
+    finally:
+        svc.close()
+
+
+def test_concurrent_scrape_hammer(dist_ctx):
+    """N scrape threads hammering /metrics + /queries + /healthz +
+    /slo while multiple submitters drive queries through the service:
+    every response parses, every query completes, zero ledger leaks —
+    the dynamic corroboration of the lock-consistent snapshot path."""
+    left, right = _tables(dist_ctx, seed=11)
+    direct = _pipe(left, right).execute().to_pydict()
+    svc = QueryService(name="hammer-obs")
+    obs = ObsServer(service=svc, port=0).start()
+    n_scrapers, n_submitters, per = 4, 3, 3
+    errors = []
+    results = []
+    stop = threading.Event()
+    barrier = threading.Barrier(n_scrapers + n_submitters)
+
+    def scraper(i):
+        barrier.wait(timeout=30)
+        routes = ("/metrics", "/queries", "/healthz", "/slo")
+        k = 0
+        while not stop.is_set() or k < 4:
+            route = routes[k % 4]
+            try:
+                status, body = _get(obs, route)
+                assert status == 200
+                if route == "/metrics":
+                    assert body.startswith("# TYPE")
+                else:
+                    json.loads(body)
+            except Exception as e:  # noqa: BLE001 - collected
+                errors.append((route, repr(e)))
+                break
+            k += 1
+
+    def submitter(i):
+        try:
+            barrier.wait(timeout=30)
+            tickets = [svc.submit(_pipe(left, right),
+                                  tenant=f"ham-{i}")
+                       for _ in range(per)]
+            for tk in tickets:
+                results.append(tk.result(timeout=600).to_pydict())
+        except Exception as e:  # noqa: BLE001 - collected
+            errors.append(("submit", repr(e)))
+
+    threads = [threading.Thread(target=scraper, args=(i,))
+               for i in range(n_scrapers)] + \
+              [threading.Thread(target=submitter, args=(i,))
+               for i in range(n_submitters)]
+    for t in threads:
+        t.start()
+    for t in threads[n_scrapers:]:
+        t.join(timeout=600)
+    stop.set()
+    for t in threads[:n_scrapers]:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert len(results) == n_submitters * per
+    for got in results:
+        assert {k: np.asarray(v).tolist() for k, v in got.items()} \
+            == {k: np.asarray(v).tolist()
+                for k, v in direct.items()}
+    obs.close()
+    svc.close()
+    del results, direct, got
+    gc.collect()
+    assert ledger.leak_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# span-sink rotation
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_sink_rotates_at_max_bytes(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    with telemetry.JsonlSpanSink(path, max_bytes=2048) as sink:
+        for i in range(100):
+            with telemetry.span("rot.probe", seq=i, filler="x" * 64):
+                pass
+        assert sink.rotations >= 1
+    assert os.path.exists(path)
+    assert os.path.exists(path + ".1")
+    # bounded: at most keep generations beside the live file
+    gens = glob.glob(path + ".*")
+    assert len(gens) <= telemetry.export.SPAN_LOG_KEEP
+    assert os.path.getsize(path) <= 4096
+    # every surviving line still parses
+    for p in [path] + gens:
+        for line in open(p):
+            json.loads(line)
+
+
+def test_jsonl_sink_env_knob_bounds_path_targets(tmp_path,
+                                                 monkeypatch):
+    monkeypatch.setenv("CYLON_SPAN_LOG_MAX_BYTES", "1024")
+    path = str(tmp_path / "trace.jsonl")
+    with telemetry.JsonlSpanSink(path) as sink:
+        for i in range(60):
+            with telemetry.span("rot.env", seq=i, filler="y" * 64):
+                pass
+        assert sink.rotations >= 1
+    assert os.path.exists(path + ".1")
+
+
+def test_rotating_writer_keeps_n_generations(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    w = RotatingJsonlWriter(path, max_bytes=64, keep=2).open()
+    for i in range(50):
+        w.write_line(json.dumps({"i": i, "pad": "z" * 40}))
+    w.close()
+    assert os.path.exists(path + ".1")
+    assert os.path.exists(path + ".2")
+    assert not os.path.exists(path + ".3")
+    assert w.rotations >= 3
