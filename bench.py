@@ -577,9 +577,46 @@ def bench_service_pipeline(ctx, n_rows: int, iters: int = 3) -> dict:
             _sync(mk_pipe().execute())
         seq_s = (time.perf_counter_ns() - t0) / 1e9
 
+    def qerror_buckets():
+        # per-kind cumulative bucket counts of the q-error histograms
+        # (registry accumulates process-wide; the service-phase p95 is
+        # computed over the BEFORE/AFTER delta so earlier bench
+        # phases' estimates cannot leak into this config's gate)
+        out = {}
+        for name, labels, m in telemetry.REGISTRY.series():
+            if name == "cylon_estimate_qerror" and \
+                    m.kind == "histogram":
+                st = m.stats()
+                out[dict(labels).get("kind", "")] = \
+                    (m.buckets, list(st["counts"]))
+        return out
+
+    def delta_qerror_p95(before, after):
+        worst = None
+        for kind, (buckets, counts1) in after.items():
+            counts0 = before.get(kind, (buckets, [0] * len(counts1)))[1]
+            counts = [a - b for a, b in zip(counts1, counts0)]
+            total = sum(counts)
+            if total <= 0:
+                continue
+            rank = 0.95 * total
+            cum, lo = 0, 1.0            # q-error floor: 1.0
+            p95 = float(buckets[-1])    # +Inf bucket: report last edge
+            for bound, c in zip(buckets, counts):
+                if cum + c >= rank and c > 0:
+                    p95 = lo + (bound - lo) * (rank - cum) / c
+                    break
+                cum += c
+                lo = bound
+            worst = p95 if worst is None else max(worst, p95)
+        return worst
+
     h0 = snap("cylon_plan_cache_hits_total")
     m0 = snap("cylon_plan_cache_misses_total")
     c0 = compile_seconds()
+    q0 = qerror_buckets()
+    sa0 = telemetry.metrics_snapshot().get(
+        'cylon_admission_est_source_total{source="measured"}', 0)
     # builds baseline BEFORE the service runs: the warm-up execute
     # already built every factory this shape needs, so a correct warm
     # cache shows zero builds across the WHOLE service phase — and the
@@ -605,6 +642,14 @@ def bench_service_pipeline(ctx, n_rows: int, iters: int = 3) -> dict:
     # is the only service phase of the bench run
     wait_p95 = telemetry.REGISTRY.histogram(
         "cylon_service_wait_seconds").quantile(0.95)
+    # estimate-accuracy observatory rollups (telemetry/stats.py): the
+    # worst per-kind q-error p95 OF THIS PHASE (bucket-delta
+    # interpolation — 1.0 = perfect; LOWER is better in benchtrend)
+    # and how many admissions this phase ran on measured statistics
+    # instead of static bounds
+    qerror_p95 = delta_qerror_p95(q0, qerror_buckets())
+    stats_admits = telemetry.metrics_snapshot().get(
+        'cylon_admission_est_source_total{source="measured"}', 0) - sa0
     world = max(ctx.get_world_size(), 1)
     return {
         "world": world,
@@ -622,6 +667,9 @@ def bench_service_pipeline(ctx, n_rows: int, iters: int = 3) -> dict:
         "wait_p95_s": _sig(wait_p95, 4) if wait_p95 is not None
         else None,
         "queries_per_s": _sig(N / svc_s, 4) if svc_s else 0.0,
+        "qerror_p95": _sig(qerror_p95, 4) if qerror_p95 is not None
+        else None,
+        "stats_informed_admits": stats_admits,
     }
 
 

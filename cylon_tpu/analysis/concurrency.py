@@ -122,13 +122,25 @@ DECLARED_ENTRIES: Tuple[Tuple[str, str, str], ...] = (
     ("api", "telemetry.slo", "observe"),
     ("api", "telemetry.slo", "state"),
     ("api", "telemetry.slo", "reset"),
+    # the statistics warehouse: fed by the querylog root hook, read by
+    # the admission path (submitters + the executor worker), scraped
+    # by /stats request threads, persisted from service lifecycle
+    ("api", "service.obs_http", "render_stats"),
+    ("api", "telemetry.stats", "record_root"),
+    ("api", "telemetry.stats", "effective_bytes"),
+    ("api", "telemetry.stats", "node_obs"),
+    ("api", "telemetry.stats", "recent_drift"),
+    ("api", "telemetry.stats", "state"),
+    ("api", "telemetry.stats", "save"),
+    ("api", "telemetry.stats", "load"),
+    ("api", "telemetry.stats", "reset"),
 )
 
 # hook registrars: a function-valued argument to one of these becomes
 # hook-domain code (runs on whichever thread triggers the hook)
 HOOK_REGISTRARS = ("add_root_hook", "add_sink", "add_dump_section",
                    "set_factory_fault_hook", "set_factory_build_hook",
-                   "set_plan_memo")
+                   "set_plan_memo", "set_plan_evict_hook")
 
 _LOCK_CTORS = {
     ("threading", "Lock"): False,      # reentrant? no

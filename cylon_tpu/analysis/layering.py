@@ -66,12 +66,18 @@ DEFAULT_CONTRACTS: Tuple[LayerContract, ...] = (
         name="telemetry-leaf",
         scope=("telemetry",),
         forbid=("",),            # any intra-package import...
-        allow=("telemetry",),    # ...except telemetry's own submodules
+        allow=("telemetry", "status"),
+        # ...except telemetry's own submodules and the error taxonomy:
+        # status.py is itself a pure stdlib leaf (base-leaf contract),
+        # so telemetry -> status cannot seed a cycle — the statistics
+        # warehouse quarantines corrupt snapshots with a typed
+        # CylonDataError event instead of a stringly-typed one
         reason="telemetry is a base-layer LEAF grown into a package "
                "(spans/metrics/export): everything instruments through "
                "it, so any import back into the package is a cycle "
                "seed — gauges sample MemoryPool duck-typed, never by "
-               "importing memory.py",
+               "importing memory.py; the stdlib-only error taxonomy "
+               "status.py is the one sanctioned sibling",
     ),
     LayerContract(
         name="ops-leaf",

@@ -71,17 +71,23 @@ def _resolve_ctx(plan: ir.PlanNode, ctx):
 
 def _preflight(plan: ir.PlanNode, ctx, est=None):
     """Pre-execution memory check: estimate every node's output bytes
-    from schema widths × propagated row estimates and compare against
-    the pool's comm budget. Over-budget plans emit ONE ``plan.preflight``
-    warning span (attrs: worst node, estimate, budget) and a WARNING
-    log line — the observable moment before a potential OOM. Returns
-    (estimates map, budget). A pre-computed ``est`` map (the service
-    scheduler estimates at SUBMIT time, keyed by these same node ids)
-    skips the plan walk — the warning span still fires."""
-    from .report import preflight_estimates
+    from schema widths × propagated row estimates, CALIBRATE against
+    the statistics warehouse (report.calibrate_estimates — measured
+    EWMAs replace static bounds they undercut, never exceed them), and
+    compare against the pool's comm budget. Over-budget plans emit ONE
+    ``plan.preflight`` warning span (attrs: worst node, estimate,
+    budget) and a WARNING log line — the observable moment before a
+    potential OOM. Returns (estimates map, budget). A pre-computed
+    ``est`` map (the service scheduler estimates at SUBMIT time and
+    calibrates at dispatch, keyed by these same node ids) skips the
+    plan walk — calibration is idempotent and the warning span still
+    fires."""
+    from .report import (calibrate_estimates, effective_bytes,
+                         preflight_estimates)
 
     if est is None:
         est = preflight_estimates(plan)
+    calibrate_estimates(plan, est, _world(ctx) if ctx is not None else 1)
     pool = getattr(ctx, "memory_pool", None) if ctx is not None else None
     # effective budget = pool comm budget clamped by an armed chaos
     # `pool` fault spec — the [MEM] markers, the warning span AND the
@@ -90,26 +96,31 @@ def _preflight(plan: ir.PlanNode, ctx, est=None):
     if not budget:
         return est, budget
     over = [n for n in ir.walk(plan)
-            if (b := est[id(n)]["bytes"]) is not None and b > budget]
+            if (b := effective_bytes(est[id(n)])) is not None
+            and b > budget]
     if over:
-        worst = max(over, key=lambda n: est[id(n)]["bytes"])
+        worst = max(over, key=lambda n: effective_bytes(est[id(n)]))
         with _span("plan.preflight", over_budget_nodes=len(over),
                    worst_node=f"{type(worst).__name__}"
                               f"({worst.args_repr()})",
-                   est_bytes=int(est[id(worst)]["bytes"]),
+                   est_bytes=int(effective_bytes(est[id(worst)])),
                    comm_budget_bytes=int(budget)):
             telemetry.logger.warning(
                 "plan.preflight: %d node(s) estimate beyond the comm "
                 "budget (%d B); worst %s at %d B — expect blocked/"
                 "chunked execution or an OOM",
                 len(over), budget, type(worst).__name__,
-                est[id(worst)]["bytes"])
+                effective_bytes(est[id(worst)]))
     return est, budget
 
 
 def _admit(plan: ir.PlanNode, ctx, est, budget):
-    """Run the admission controller over the pre-flight estimates:
-    records the decision (counter + log + flight admission ring) and
+    """Run the admission controller over the (calibrated) pre-flight
+    estimates: records the decision (counter + log + flight admission
+    ring), stamps the decision + its estimate provenance onto the open
+    ``plan.query`` root span (the query-log digest's
+    ``admission``/``est_bytes``/``est_source`` fields — stamped BEFORE
+    enforce so a shed query's digest still names the decision), and
     ENFORCES a shed — an over-budget query raises
     :class:`CylonResourceExhausted` here, before any device work. A
     degrade decision returns the per-join ``probe_block_rows`` map the
@@ -120,12 +131,35 @@ def _admit(plan: ir.PlanNode, ctx, est, budget):
     # record() also emits the plan.admission marker span for non-admit
     # decisions — shared with the service scheduler's dispatch path
     _admission.record(decision)
+    telemetry.annotate(admission=decision.action,
+                       est_bytes=decision.est_bytes,
+                       est_source=decision.est_source)
     _admission.enforce(decision)
     return decision
 
 
+def _stamp_plan_fp(root_span, plan: ir.PlanNode, ctx,
+                   plan_fp=None) -> None:
+    """Make sure the ``plan.query`` root span carries a plan
+    fingerprint — the statistics warehouse's per-query key and the
+    digest's join column. The service path stamps the LOGICAL-plan
+    fingerprint through root_attrs (the plan-cache key space, which
+    drift eviction must match); the library path passes the same
+    logical fingerprint down from ``LazyTable.execute``. Only when
+    neither exists (a raw ``executor.execute`` call on a hand-built
+    plan) is the fingerprint derived from the plan at hand."""
+    if root_span.attrs.get("plan_fp"):
+        return
+    if plan_fp is None:
+        from .fingerprint import fingerprint
+
+        plan_fp = fingerprint(plan, _world(ctx) if ctx is not None
+                              else 1)
+    root_span.set(plan_fp=plan_fp)
+
+
 def execute(plan: ir.PlanNode, ctx=None, decision=None,
-            est=None) -> Table:
+            est=None, plan_fp=None) -> Table:
     """Execute a plan; returns the result Table (sharded when the
     context is distributed). ``ctx`` defaults to the first scanned
     table's context. Runs under the per-query deadline
@@ -145,16 +179,19 @@ def execute(plan: ir.PlanNode, ctx=None, decision=None,
     cross the root errored, so the forensic trail matches
     ``execute_analyzed``."""
     rctx = _resolve_ctx(plan, ctx)
-    with _span("plan.query"):
+    with _span("plan.query") as root_span:
+        _stamp_plan_fp(root_span, plan, rctx, plan_fp)
         with _resil.query_deadline():
             est, budget = _preflight(plan, rctx, est=est)
             if decision is None:
                 decision = _admit(plan, rctx, est, budget)
-            return _Exec(ctx, degrade=decision.degrade_blocks).run(plan)
+            return _Exec(ctx, degrade=decision.degrade_blocks,
+                         est=est).run(plan)
 
 
 def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None,
-                     decision=None, est=None) -> Tuple[Table, "object"]:
+                     decision=None, est=None,
+                     plan_fp=None) -> Tuple[Table, "object"]:
     """Execute with per-node measurement; returns (Table, PlanReport).
 
     The whole run nests under one ``plan.query`` span (the report's
@@ -170,12 +207,13 @@ def execute_analyzed(plan: ir.PlanNode, ctx=None, stats=None,
     rctx = _resolve_ctx(plan, ctx)
     with telemetry.collect_phases() as cp:
         with _span("plan.query") as root_span:
+            _stamp_plan_fp(root_span, plan, rctx, plan_fp)
             with _resil.query_deadline():
                 est, budget = _preflight(plan, rctx, est=est)
                 if decision is None:
                     decision = _admit(plan, rctx, est, budget)
                 ex = _Exec(ctx, recorder=_Recorder(cp.labels),
-                           degrade=decision.degrade_blocks)
+                           degrade=decision.degrade_blocks, est=est)
                 result = ex.run(plan)
     leaks = _ledger.leak_report(root_span.span_id,
                                 exclude={id(result)})
@@ -224,12 +262,36 @@ class _Recorder:
 
 class _Exec:
     def __init__(self, ctx=None, recorder: Optional[_Recorder] = None,
-                 degrade: Optional[dict] = None):
+                 degrade: Optional[dict] = None,
+                 est: Optional[dict] = None):
         self.ctx = ctx
         self._recorder = recorder
         # id(Join node) -> probe_block_rows, from the admission
         # controller's degrade decision (blocked/chunked lowering)
         self._degrade = degrade or {}
+        # the calibrated pre-flight estimate map (report.
+        # calibrate_estimates): carries each stats-tracked node's
+        # sub-fingerprint + the estimate admission used, so the
+        # lowering can stamp them onto its span for the statistics
+        # warehouse to join against the measured output
+        self._est = est or {}
+
+    def _stamp_stats(self, sp, node: ir.PlanNode, out: Table) -> None:
+        """Attach the statistics-warehouse feed to a node's span:
+        sub-fingerprint, the (calibrated) estimate that was acted on,
+        and the measured output size. ``bytes_out`` (Table.nbytes) and
+        ``rows_out`` (capacity) are host arithmetic over known shapes
+        — no device sync, so the default execute path stays as cheap
+        as before."""
+        from .report import effective_bytes
+
+        e = self._est.get(id(node))
+        if e is None or "node_fp" not in e:
+            return
+        sp.set(stats_fp=e["node_fp"], stats_kind=node.kind,
+               est_bytes=effective_bytes(e),
+               est_source=e.get("est_source", "static"),
+               bytes_out=int(out.nbytes), rows_out=int(out.capacity))
 
     def run(self, node: ir.PlanNode) -> Table:
         # node boundaries are the deadline check points: a query past
@@ -316,9 +378,11 @@ class _Exec:
         if sig is not None and t._hash_partitioned == sig:
             return t
         with _span("plan.shuffle.explicit", self._seq(),
-                   world=_world(self.ctx), rows_in=t.capacity):
-            return _ledger.track(dist_ops.shuffle(t, node.keys),
-                                 "plan.shuffle")
+                   world=_world(self.ctx), rows_in=t.capacity) as sp:
+            out = _ledger.track(dist_ops.shuffle(t, node.keys),
+                                "plan.shuffle")
+            self._stamp_stats(sp, node, out)
+            return out
 
     def _do_join(self, node: ir.Join) -> Table:
         l, r = node.children
@@ -350,18 +414,21 @@ class _Exec:
                 # distributed_join short-circuits to the local join
                 # anyway — this is that path with an explicit block)
                 sp.set(mode="blocked", probe_block_rows=int(blk))
-                return _ledger.track(
+                out = _ledger.track(
                     lt.join(rt, node.how, node.algorithm,
                             left_on=list(node.left_on),
                             right_on=list(node.right_on),
                             probe_block_rows=int(blk)),
                     "plan.join")
-            return _ledger.track(
-                lt.distributed_join(
-                    rt, node.how, node.algorithm,
-                    left_on=list(node.left_on),
-                    right_on=list(node.right_on)),
-                "plan.join")
+            else:
+                out = _ledger.track(
+                    lt.distributed_join(
+                        rt, node.how, node.algorithm,
+                        left_on=list(node.left_on),
+                        right_on=list(node.right_on)),
+                    "plan.join")
+            self._stamp_stats(sp, node, out)
+            return out
 
     def _do_groupby(self, node: ir.GroupBy) -> Table:
         from ..parallel import dist_ops, shard
@@ -370,11 +437,13 @@ class _Exec:
         ops = [table_mod._as_agg_op(o) for o in node.ops]
         if _world(self.ctx) == 1:
             with _span("plan.groupby", self._seq(), world=1,
-                       rows_in=t.capacity):
-                return _ledger.track(
+                       rows_in=t.capacity) as sp:
+                out = _ledger.track(
                     table_mod.groupby_local(t, node.keys,
                                             node.agg_cols, ops),
                     "plan.groupby")
+                self._stamp_stats(sp, node, out)
+                return out
         local = False
         if node.local_ok:
             # re-verify the plan's claim against the runtime witness —
@@ -385,12 +454,14 @@ class _Exec:
             local = sig is not None and t._hash_partitioned == sig
         label = "plan.groupby" if local else "plan.shuffle.groupby"
         with _span(label, self._seq(), world=_world(self.ctx),
-                   local=local, rows_in=t.capacity):
-            return _ledger.track(
+                   local=local, rows_in=t.capacity) as sp:
+            out = _ledger.track(
                 dist_ops.distributed_groupby(
                     t, node.keys, node.agg_cols, ops,
                     pre_partitioned=local),
                 "plan.groupby")
+            self._stamp_stats(sp, node, out)
+            return out
 
     def _do_setop(self, node: ir.SetOp) -> Table:
         lt = self.run(node.children[0])

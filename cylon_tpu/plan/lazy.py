@@ -197,6 +197,14 @@ class LazyTable:
         service/plancache.py)."""
         return _optimize_root(self._plan_copy(), self._world())
 
+    def plan_fingerprint(self) -> str:
+        """The structural fingerprint of this query's LOGICAL plan
+        (plan/fingerprint.py) — the plan-cache key and the statistics
+        warehouse's per-query key; stable across processes."""
+        from .fingerprint import fingerprint
+
+        return fingerprint(self._node, self._world())
+
     def explain(self, optimize: bool = True, analyze: bool = False) -> str:
         """The plan as text. ``analyze=True`` EXECUTES the query
         (PostgreSQL EXPLAIN ANALYZE semantics) and renders the plan
@@ -223,12 +231,18 @@ class LazyTable:
         stats: Optional[PlanStats] = None
         if optimize:
             root, stats = _optimize_root(root, self._world())
+        # the LOGICAL-plan fingerprint rides to the executor's root
+        # span: the query-log digest's join key, the statistics
+        # warehouse's per-query key, and — critically — the plan-cache
+        # key space drift eviction must match (fingerprinting the
+        # OPTIMIZED root here would fork the key space)
+        fp = self.plan_fingerprint()
         if analyze:
             result, report = _execute_analyzed(root, self._ctx,
-                                               stats=stats)
+                                               stats=stats, plan_fp=fp)
             self.last_report = report
         else:
-            result = _execute(root, self._ctx)
+            result = _execute(root, self._ctx, plan_fp=fp)
         if stats is not None:
             self.last_stats = stats
         if out_id is not None:

@@ -137,6 +137,55 @@ def preflight_estimates(root: ir.PlanNode) -> Dict[int, dict]:
     return est
 
 
+def calibrate_estimates(root: ir.PlanNode, est: Dict[int, dict],
+                        world: int) -> Dict[int, dict]:
+    """Overlay the statistics warehouse onto a pre-flight estimate map
+    (in place; returns it). For every shuffle/join/groupby node the
+    entry gains:
+
+    * ``node_fp``   — the node's structural sub-fingerprint
+      (plan/fingerprint.py), the key the executor stamps onto the
+      node's span so measurements land back in the warehouse;
+    * ``calibrated_bytes`` + ``est_source="measured"`` — once the
+      fingerprint has >= ``CYLON_STATS_MIN_OBS`` successful
+      observations: ``min(static, ewma x CYLON_STATS_SAFETY)``, the
+      estimate admission actually uses. Soundness is structural: never
+      above the static width x row bound, so calibration only relaxes
+      false alarms. Entries without qualified stats keep
+      ``est_source="static"``.
+
+    Idempotent (keyed on ``node_fp`` presence), so the service path —
+    which estimates at submit time but calibrates at DISPATCH time for
+    fresh stats — and the library path — which calibrates inside
+    ``_preflight`` — never double-apply."""
+    from ..telemetry import stats as _stats
+
+    from .fingerprint import STATS_NODE_KINDS, node_fingerprint
+
+    for node in ir.walk(root):
+        if node.kind not in STATS_NODE_KINDS:
+            continue
+        e = est.get(id(node))
+        if e is None or "node_fp" in e:
+            continue
+        fp = node_fingerprint(node, world)
+        e["node_fp"] = fp
+        e["est_source"] = "static"
+        eff, source = _stats.effective_bytes(fp, e.get("bytes"))
+        if source == "measured":
+            e["calibrated_bytes"] = eff
+            e["est_source"] = "measured"
+    return est
+
+
+def effective_bytes(e: dict) -> Optional[int]:
+    """The estimate admission and the [MEM] marker act on: the
+    calibrated value when the warehouse qualified one, the static
+    upper bound otherwise."""
+    cb = e.get("calibrated_bytes")
+    return cb if cb is not None else e.get("bytes")
+
+
 @dataclass
 class NodeMeasure:
     """One plan node's measured execution (or the reason it has none)."""
@@ -152,7 +201,15 @@ class NodeMeasure:
     children: List["NodeMeasure"] = field(default_factory=list)
     skew: Optional[dict] = None    # worst own-exchange skew (see below)
     est_bytes: Optional[int] = None  # pre-flight output-size estimate
-    mem_warn: bool = False         # est_bytes exceeded the comm budget
+    calibrated_bytes: Optional[int] = None  # stats-informed estimate
+    #                                (min(static, ewma x safety)) when
+    #                                the warehouse qualified one
+    est_source: Optional[str] = None  # "static" | "measured" for nodes
+    #                                the statistics warehouse tracks
+    mem_warn: bool = False         # effective estimate exceeded the
+    #                                comm budget (calibrated when one
+    #                                exists — the same number admission
+    #                                acted on)
     retries: int = 0               # retried stages under this node's
     #                                own spans (resilience layer)
 
@@ -174,6 +231,8 @@ class NodeMeasure:
                   f"{warn}")
         est = f", est={_human_bytes(self.est_bytes)}" \
             if self.est_bytes is not None else ""
+        if self.calibrated_bytes is not None:
+            est += f", calibrated={_human_bytes(self.calibrated_bytes)}"
         mem = "  [MEM]" if self.mem_warn else ""
         rt = f"  [RETRY×{self.retries}]" if self.retries else ""
         return (f"{self.desc}{pb}  (actual time={self.ms:.2f} ms, "
@@ -188,7 +247,10 @@ class NodeMeasure:
             "executed": self.executed,
             "ms": round(self.ms, 3) if self.ms is not None else None,
             "rows": self.rows, "bytes": self.bytes,
-            "est_bytes": self.est_bytes, "mem_warn": self.mem_warn,
+            "est_bytes": self.est_bytes,
+            "calibrated_bytes": self.calibrated_bytes,
+            "est_source": self.est_source,
+            "mem_warn": self.mem_warn,
             "retries": self.retries,
             "shuffles": self.shuffles, "labels": list(self.labels),
             "skew": dict(self.skew) if self.skew is not None else None,
@@ -242,12 +304,15 @@ def build_measures(node: ir.PlanNode, recs: Dict[int, object],
     r = recs.get(id(node))
     e = (est or {}).get(id(node), {})
     est_b = e.get("bytes")
+    eff_b = effective_bytes(e)
     base = dict(kind=node.kind,
                 desc=f"{type(node).__name__}({node.args_repr()})",
                 partitioned_by=node.partitioned_by, children=children,
                 est_bytes=est_b,
-                mem_warn=bool(budget) and est_b is not None
-                and est_b > budget)
+                calibrated_bytes=e.get("calibrated_bytes"),
+                est_source=e.get("est_source"),
+                mem_warn=bool(budget) and eff_b is not None
+                and eff_b > budget)
     if r is None:
         return NodeMeasure(executed=False, **base)
     covered = [False] * (r.i1 - r.i0)

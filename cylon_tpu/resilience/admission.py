@@ -82,15 +82,22 @@ class Decision:
 
     action: str                    # "admit" | "degrade" | "shed"
     budget: Optional[int] = None
-    est_bytes: Optional[int] = None   # worst node estimate
+    est_bytes: Optional[int] = None   # worst node EFFECTIVE estimate
     worst_node: Optional[str] = None
     reason: str = ""
+    # provenance of the worst-node estimate the decision acted on:
+    # "static" (width x row upper bound) or "measured" (the statistics
+    # warehouse's EWMA-calibrated value, telemetry/stats.py). Rides
+    # the admission ring and the query-log digest, so a forensic
+    # record always says WHICH estimator admitted or shed the query.
+    est_source: str = "static"
     # id(join node) -> probe_block_rows for degraded lowerings
     degrade_blocks: Dict[int, int] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {"action": self.action, "budget": self.budget,
                 "est_bytes": self.est_bytes,
+                "est_source": self.est_source,
                 "worst_node": self.worst_node, "reason": self.reason,
                 "degraded_nodes": len(self.degrade_blocks)}
 
@@ -99,51 +106,86 @@ def _node_desc(node) -> str:
     return f"{type(node).__name__}({node.args_repr()})"
 
 
+def _effective(e: dict):
+    """(effective bytes, source) for one estimate entry: the
+    statistics-warehouse calibration when plan/report.py stamped one
+    (``calibrated_bytes`` = min(static, ewma x safety) — never above
+    the static bound), the static width x row estimate otherwise.
+    Duck-typed dict read: admission never imports plan/ or the
+    warehouse — calibration happened upstream."""
+    cb = e.get("calibrated_bytes")
+    if cb is not None:
+        return cb, e.get("est_source", "measured")
+    return e.get("bytes"), "static"
+
+
 def decide(nodes: List[object], est: Dict[int, dict],
            budget: Optional[int], world: int) -> Decision:
     """The pure decision function: ``nodes`` is the plan's node list
     (duck-typed — ``kind``/``args_repr``; admission never imports
-    plan/), ``est`` the pre-flight estimate map keyed by id(node).
+    plan/), ``est`` the (possibly stats-calibrated) pre-flight
+    estimate map keyed by id(node). Every comparison runs against the
+    EFFECTIVE estimate — measured EWMA x safety once a fingerprint has
+    enough observations, static bound otherwise — so a repeat query
+    the warehouse has watched fit in budget is admitted, while the
+    min() with the static bound keeps the decision sound (a measured
+    estimate still over budget sheds exactly like a static one).
     Raises nothing; the executor enforces a shed decision."""
-    if not budget:
-        return Decision("admit", budget=budget,
-                        reason="no budget knowable")
     # Scans are excluded: their bytes are ALREADY resident (borrowed
     # user inputs) — admission controls the allocations a query is
     # about to make, not history it cannot undo
-    over = [(n, est[id(n)]["bytes"]) for n in nodes
-            if n.kind != "scan"
-            and est.get(id(n), {}).get("bytes") is not None
-            and est[id(n)]["bytes"] > budget]
+    allocating = [(n, *_effective(est.get(id(n), {}))) for n in nodes
+                  if n.kind != "scan"]
+    allocating = [(n, b, src) for n, b, src in allocating
+                  if b is not None]
+    if not budget:
+        # no budget to enforce, but the forensic record still carries
+        # the worst allocating estimate + its provenance — the digest
+        # and admission ring stay joinable against measured truth even
+        # on budget-hidden backends
+        worst = max(allocating, key=lambda p: p[1], default=None)
+        if worst is None:
+            return Decision("admit", budget=budget,
+                            reason="no budget knowable")
+        return Decision("admit", budget=budget, est_bytes=worst[1],
+                        est_source=worst[2],
+                        reason="no budget knowable")
+    over = [(n, b, src) for n, b, src in allocating if b > budget]
     if not over:
         # worst ALLOCATING estimate only — a huge borrowed Scan input
         # must not make an admitted query's forensic record look like
         # a waved-through 500x overrun
-        worst = max(
-            (est[id(n)]["bytes"] for n in nodes if n.kind != "scan"
-             if est.get(id(n), {}).get("bytes") is not None),
-            default=None)
-        return Decision("admit", budget=budget, est_bytes=worst,
-                        reason="within budget")
-    worst_node, worst_bytes = max(over, key=lambda p: p[1])
+        worst = max(allocating, key=lambda p: p[1], default=None)
+        if worst is None:
+            return Decision("admit", budget=budget,
+                            reason="within budget")
+        return Decision("admit", budget=budget, est_bytes=worst[1],
+                        est_source=worst[2],
+                        reason="within budget"
+                        + (" (stats-calibrated)"
+                           if worst[2] == "measured" else ""))
+    worst_node, worst_bytes, worst_src = max(over, key=lambda p: p[1])
     factor = worst_bytes / budget
     if factor > shed_factor():
         # beyond the shed factor NOTHING saves the query — the blocked
         # path bounds the join's WORKING SET, but the estimate is the
-        # OUTPUT size, which degrade still materializes in full
+        # OUTPUT size, which degrade still materializes in full. A
+        # MEASURED estimate this far over budget sheds identically:
+        # the warehouse relaxes false alarms, never real ones.
         return Decision(
             "shed", budget=budget, est_bytes=worst_bytes,
+            est_source=worst_src,
             worst_node=_node_desc(worst_node),
-            reason=f"estimate {factor:.1f}x over budget "
+            reason=f"{worst_src} estimate {factor:.1f}x over budget "
                    f"(shed factor {shed_factor():.1f}, "
                    f"world={world})")
     # degrade: an over-budget JOIN can chunk its probe side so one
     # block's working set fits. Only when EVERY over-budget node is a
     # degradable join — degrading the join while a downstream node
     # still blows the budget helps nothing.
-    over_joins = [(n, b) for n, b in over if n.kind == "join"]
+    over_joins = [(n, b) for n, b, _src in over if n.kind == "join"]
     degradable = world == 1 and over_joins \
-        and all(n.kind == "join" for n, _b in over)
+        and all(n.kind == "join" for n, _b, _src in over)
     if degradable:
         blocks: Dict[int, int] = {}
         for n, b in over_joins:
@@ -155,6 +197,7 @@ def decide(nodes: List[object], est: Dict[int, dict],
         if blocks:
             return Decision(
                 "degrade", budget=budget, est_bytes=worst_bytes,
+                est_source=worst_src,
                 worst_node=_node_desc(worst_node),
                 degrade_blocks=blocks,
                 reason=f"{len(blocks)} join(s) over budget -> "
@@ -163,10 +206,11 @@ def decide(nodes: List[object], est: Dict[int, dict],
     # — the exchange bounds its own comm buffers against this budget,
     # and the pre-flight warning span already flags the risk
     return Decision("admit", budget=budget, est_bytes=worst_bytes,
+                    est_source=worst_src,
                     worst_node=_node_desc(worst_node),
-                    reason=f"estimate {factor:.1f}x over budget, "
-                           f"under shed factor — admitted with "
-                           f"warning")
+                    reason=f"{worst_src} estimate {factor:.1f}x over "
+                           f"budget, under shed factor — admitted "
+                           f"with warning")
 
 
 def record(decision: Decision, tenant: Optional[str] = None
@@ -178,6 +222,12 @@ def record(decision: Decision, tenant: Optional[str] = None
     query's forensic record says WHOSE query was shed."""
     _metrics.REGISTRY.counter("cylon_admission_total",
                               {"decision": decision.action}).inc()
+    # which estimator is steering admission — the closed-loop health
+    # signal (bench surfaces the measured-admit count as
+    # service_pipeline.stats_informed_admits)
+    _metrics.REGISTRY.counter(
+        "cylon_admission_est_source_total",
+        {"source": decision.est_source}).inc()
     doc = decision.to_dict()
     if tenant is not None:
         doc["tenant"] = tenant

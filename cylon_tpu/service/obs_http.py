@@ -22,7 +22,12 @@ serving four routes:
   completed queries;
 * ``GET /slo``      — per-tenant SLO state (``telemetry/slo.py``):
   latency quantile estimates, declared objective, remaining error
-  budget.
+  budget;
+* ``GET /stats``    — the query statistics warehouse
+  (``telemetry/stats.py``): top-N plan/node fingerprints with
+  observation counts and EWMAs, per-node-kind q-error p50/p95
+  (estimate accuracy), recent drift events, live knob config —
+  "what has admission learned, and is it still true".
 
 Lifecycle: ``QueryService.start()`` arms it when ``CYLON_OBS_PORT`` is
 nonzero (0 — the default — disables it); ``ObsServer`` can also be
@@ -52,10 +57,11 @@ from ..telemetry import logger as _logger
 from ..telemetry import metrics as _metrics
 from ..telemetry import querylog as _querylog
 from ..telemetry import slo as _slo
+from ..telemetry import stats as _stats
 
 DEFAULT_OBS_PORT = _knobs.default("CYLON_OBS_PORT")
 
-ROUTES = ("/metrics", "/healthz", "/queries", "/slo")
+ROUTES = ("/metrics", "/healthz", "/queries", "/slo", "/stats")
 
 
 def render_metrics() -> str:
@@ -96,6 +102,12 @@ def render_slo() -> dict:
     return _slo.state()
 
 
+def render_stats() -> dict:
+    """The /stats payload: the statistics warehouse's state — top
+    fingerprints, q-error quantiles, drift history."""
+    return _stats.state()
+
+
 class _ObsHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer carrying the service handle for the
     handler; request threads are daemons so a hung scrape can never
@@ -130,6 +142,11 @@ class _Handler(BaseHTTPRequestHandler):
                 status = 200
             elif path == "/slo":
                 body = json.dumps(render_slo(), default=str,
+                                  sort_keys=True).encode("utf-8")
+                ctype = "application/json"
+                status = 200
+            elif path == "/stats":
+                body = json.dumps(render_stats(), default=str,
                                   sort_keys=True).encode("utf-8")
                 ctype = "application/json"
                 status = 200

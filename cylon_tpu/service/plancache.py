@@ -54,7 +54,6 @@ shapes. ``CYLON_PLAN_CACHE_MAX`` bounds the cache (default 64);
 from __future__ import annotations
 
 import copy
-import hashlib
 import threading
 from collections import OrderedDict
 from contextlib import contextmanager
@@ -62,80 +61,22 @@ from dataclasses import replace as _dc_replace
 from typing import Optional, Tuple
 
 from ..plan import ir
+# the structural fingerprint moved to plan/fingerprint.py (the
+# statistics warehouse keys by the same function, from below the
+# service tier); re-exported here unchanged — this module remains the
+# semantics owner of what the key covers (docstring above)
+from ..plan.fingerprint import FP_VERSION, fingerprint  # noqa: F401
 from ..plan.optimizer import PlanStats, optimize as _optimize
 from ..plan.verify import check_plan as _check_plan
 from ..telemetry import knobs as _knobs
 from ..telemetry import metrics as _metrics
+from ..telemetry import stats as _stats
 
 DEFAULT_CACHE_MAX = _knobs.default("CYLON_PLAN_CACHE_MAX")
-
-FP_VERSION = 1
 
 
 def cache_max() -> int:
     return _knobs.get("CYLON_PLAN_CACHE_MAX")
-
-
-# ---------------------------------------------------------------------------
-# structural fingerprint
-# ---------------------------------------------------------------------------
-
-
-def _expr_tokens(e) -> tuple:
-    """Canonical token tree for a bound filter expression — positions,
-    operators and literals (type + repr, so ``3`` and ``3.0`` differ),
-    never Python object identity."""
-    if isinstance(e, ir.Cmp):
-        return ("cmp", int(e.pos), str(e.op), type(e.value).__name__,
-                repr(e.value))
-    if isinstance(e, ir.BoolOp):
-        return (str(e.op), _expr_tokens(e.a), _expr_tokens(e.b))
-    if isinstance(e, ir.Not):
-        return ("not", _expr_tokens(e.a))
-    return ("expr", repr(e))  # future Expr kinds: repr is still stable
-
-
-def _node_tokens(n: ir.PlanNode) -> tuple:
-    """Canonical token tree for one plan node + its subtree."""
-    if isinstance(n, ir.Scan):
-        sig = n.witness_sig
-        wit = None if sig is None else (
-            tuple(int(i) for i in sig[0]),
-            tuple(str(d) for d in sig[1]), int(sig[2]))
-        extra: tuple = ("witness", wit, n.width)
-    elif isinstance(n, ir.Project):
-        extra = ("cols", tuple(n.cols))
-    elif isinstance(n, ir.Filter):
-        extra = ("expr", _expr_tokens(n.expr))
-    elif isinstance(n, ir.Shuffle):
-        extra = ("keys", tuple(n.keys))
-    elif isinstance(n, ir.Join):
-        extra = ("on", tuple(n.left_on), tuple(n.right_on),
-                 str(n.how), str(n.algorithm))
-    elif isinstance(n, ir.GroupBy):
-        extra = ("agg", tuple(n.keys), tuple(n.agg_cols), tuple(n.ops))
-    elif isinstance(n, ir.SetOp):
-        extra = ("op", str(n.op))
-    elif isinstance(n, ir.Sort):
-        extra = ("by", tuple(n.by), tuple(bool(a) for a in n.ascending))
-    else:
-        extra = ("args", n.args_repr())
-    # schema (column NAMES) is part of the key: names flow into
-    # EXPLAIN/report renders and admission worst-node forensics, so a
-    # hit must guarantee the cached template's names are the query's
-    # own — two shapes that differ only in names get two entries
-    return (n.kind, tuple(n.schema), tuple(n.types)) + extra + \
-        tuple(_node_tokens(c) for c in n.children)
-
-
-def fingerprint(root: ir.PlanNode, world: int) -> str:
-    """Stable hex fingerprint of a logical plan's STRUCTURE under a
-    given world size. Pure function of the token tree through sha256 —
-    no ``id()``, no Python ``hash()`` (which is seed-randomized for
-    strings), so the same shape fingerprints identically across
-    processes and runs."""
-    doc = ("cylon-plan-fp", FP_VERSION, int(world), _node_tokens(root))
-    return hashlib.sha256(repr(doc).encode("utf-8")).hexdigest()
 
 
 # ---------------------------------------------------------------------------
@@ -214,9 +155,10 @@ class PlanCache:
                 self._counter("evictions").inc()
         return opt_root, stats
 
-    def invalidate(self, fp: str) -> None:
+    def invalidate(self, fp: str) -> bool:
+        """Drop one entry; True when something was actually removed."""
         with self._lock:
-            self._entries.pop(fp, None)
+            return self._entries.pop(fp, None) is not None
 
     def _rebind(self, fp: str, entry: tuple, root: ir.PlanNode,
                 world: int) -> Optional[Tuple[ir.PlanNode, PlanStats]]:
@@ -305,9 +247,25 @@ def memo_optimize(root: ir.PlanNode, world: int
     return _global.optimize(root, world)
 
 
+def _evict_on_drift(fp: str) -> None:
+    """The statistics warehouse's drift-eviction hook: a measured
+    distribution shift on a fingerprint means the cached optimized
+    template was learned against a world that no longer exists — drop
+    it so the next submission re-optimizes (and the store re-learns
+    from fresh measurements). Counted only when an entry was actually
+    removed — a disabled cache, an already-LRU-evicted entry, or a
+    second drifted node of the same plan must not inflate the
+    evictions series."""
+    if _global.invalidate(fp):
+        _metrics.REGISTRY.counter(
+            "cylon_plan_cache_evictions_total").inc()
+
+
 def install() -> None:
     """Register the global cache as plan/'s late-bound optimize memo
+    and as the statistics warehouse's drift-eviction target
     (idempotent; called by ``cylon_tpu.service`` at import)."""
     from ..plan import lazy as _lazy
 
     _lazy.set_plan_memo(memo_optimize)
+    _stats.set_plan_evict_hook(_evict_on_drift)

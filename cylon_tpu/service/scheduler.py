@@ -55,7 +55,7 @@ from typing import Deque, Dict, Optional
 from ..plan import ir
 from ..plan.executor import (execute as _execute,
                              execute_analyzed as _execute_analyzed)
-from ..plan.report import preflight_estimates
+from ..plan.report import calibrate_estimates, preflight_estimates
 from ..resilience import admission as _admission
 from ..resilience import retry as _retry
 from ..status import (Code, CylonPlanError, CylonResourceExhausted,
@@ -65,6 +65,7 @@ from ..telemetry import knobs as _knobs
 from ..telemetry import logger as _logger
 from ..telemetry import metrics as _metrics
 from ..telemetry import root_attrs as _root_attrs
+from ..telemetry import stats as _stats
 from . import plancache as _plancache
 
 DEFAULT_QUEUE_MAX = _knobs.default("CYLON_SERVICE_QUEUE_MAX")
@@ -216,7 +217,20 @@ class QueryService:
         """Start the executor worker (idempotent) — and, when
         ``CYLON_OBS_PORT`` is nonzero, the observability HTTP endpoint
         (``service/obs_http.py``) serving this service's /metrics,
-        /healthz, /queries and /slo on a daemon thread."""
+        /healthz, /queries, /slo and /stats on a daemon thread. When
+        ``CYLON_STATS_PATH`` names a saved statistics snapshot, the
+        warehouse warm-starts from it BEFORE the first dispatch, so a
+        fresh replica's repeat-shape queries get measured-calibrated
+        admission from query 1 (a corrupt snapshot is quarantined —
+        never blocks startup)."""
+        with self._cv:
+            if self._worker is not None or self._closed:
+                return
+        # warm-start outside the lock (file IO must not block
+        # submitters); the worker is not running yet, so no dispatch
+        # precedes the load — and load() merges via setdefault, so a
+        # racing second start() loading again is harmless
+        _stats.load()
         obs = None
         with self._cv:
             if self._worker is not None or self._closed:
@@ -263,6 +277,7 @@ class QueryService:
         hanging their waiters forever."""
         orphans = []
         with self._cv:
+            already_closed = self._closed
             self._closed = True
             worker = self._worker
             obs, self._obs = self._obs, None
@@ -286,6 +301,16 @@ class QueryService:
             # the drain finishes, then shuts down with its thread
             # joined (no leaked obs thread past close())
             obs.close(timeout)
+        # snapshot the statistics warehouse AFTER the drain: every
+        # query this service ran has fed its digest by now, so the
+        # file the next replica warm-starts from carries the full run
+        # (no-op unless CYLON_STATS_PATH is set; never raises). Only
+        # a STARTED service saves — start() is what merged the
+        # existing snapshot into the store, so a never-started (or
+        # re-)close() must not rotate a learned warm-start file aside
+        # and replace it with a near-empty one
+        if worker is not None and not already_closed:
+            _stats.save()
 
     def __enter__(self) -> "QueryService":
         self.start()
@@ -326,7 +351,15 @@ class QueryService:
         # the job into the query-log digest.
         _plancache.clear_last_event()
         root, stats = query.optimized()
-        cache_doc = _plancache.last_event()
+        cache_doc = dict(_plancache.last_event() or {})
+        if not cache_doc.get("plan_fp"):
+            # cache disabled/bypassed: derive the LOGICAL-plan
+            # fingerprint directly so the digest and the statistics
+            # warehouse still key this query (same key space as the
+            # cache — drift eviction must match it)
+            fp_fn = getattr(query, "plan_fingerprint", None)
+            if fp_fn is not None:
+                cache_doc["plan_fp"] = fp_fn()
         est = preflight_estimates(root)
         cost = _job_cost(est, root)
         ctx = getattr(query, "context", None)
@@ -501,6 +534,11 @@ class QueryService:
         budget = _admission.effective_budget(pool)
         world = job.ctx.get_world_size() \
             if job.ctx is not None and job.ctx.is_distributed() else 1
+        # calibrate at DISPATCH time, not submit time: a queued query
+        # admitted now sees the statistics the queries ahead of it
+        # just taught the warehouse (idempotent — the executor's
+        # _preflight skips nodes already calibrated)
+        calibrate_estimates(job.root, job.est, world)
         decision = _admission.decide(list(ir.walk(job.root)), job.est,
                                      budget, world)
         outcome, result, report, error = "error", None, None, None
@@ -510,6 +548,8 @@ class QueryService:
                              service=self.name,
                              wait_s=round(wait_s, 6),
                              admission=decision.action,
+                             est_bytes=decision.est_bytes,
+                             est_source=decision.est_source,
                              **job.cache_doc):
                 # inside root_attrs so the non-admit plan.admission
                 # marker span record() emits carries the tenant label

@@ -42,6 +42,12 @@ and grows it into a measurement layer:
   histograms with p50/p95/p99 estimation, error-budget accounting
   (``CYLON_SLO_P95_MS`` / ``CYLON_SLO_TARGET``), burn events into the
   flight admission ring.
+* ``stats``   — the query statistics warehouse: per-fingerprint
+  measured EWMAs fed by the querylog hook, per-node-kind q-error
+  histograms (estimate accuracy), drift detection with plan-cache
+  eviction, stats-informed admission estimates
+  (``min(static, ewma x CYLON_STATS_SAFETY)``), JSONL warm-start
+  persistence (``CYLON_STATS_PATH``).
 * ``sampling`` — overhead-bounded head sampling for root query spans
   (``CYLON_TRACE_SAMPLE_RATE``, deterministic on the query-id hash):
   sampled-out queries keep counters/histograms/querylog but skip
@@ -70,6 +76,7 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
 from .export import JsonlSpanSink, prometheus_text, span_to_json
 from . import knobs, ledger, profiler, sampling, skew
 from . import flight
+from . import stats
 from . import querylog, slo
 from .skew import SkewStats
 
@@ -90,6 +97,9 @@ __all__ = [
     # live-service observability: query digests, per-tenant SLOs,
     # overhead-bounded trace sampling
     "querylog", "slo", "sampling",
+    # the query statistics warehouse: measured per-fingerprint stats,
+    # q-error observatory, drift detection, stats-informed admission
+    "stats",
     # the declared CYLON_* environment-knob registry
     "knobs",
 ]

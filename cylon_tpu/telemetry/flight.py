@@ -39,7 +39,11 @@ The resilience layer records into two extension points here:
 
 * **admission ring** — ``record_admission()`` keeps the last ring-size
   admission-controller decisions (admit/degrade/shed); a shed query
-  leaves the same forensic trail as a crashed one.
+  leaves the same forensic trail as a crashed one. The ring doubles as
+  the operational event journal: the SLO tracker's ``slo_burn``
+  events and the statistics warehouse's ``stats_drift`` /
+  ``stats_quarantine`` events (telemetry/stats.py) land here too, so
+  every admission-adjacent incident rides crash dumps.
 * **dump sections** — ``add_dump_section(name, provider)`` registers a
   zero-arg provider whose result is embedded in every crash dump (the
   fault injector registers its armed-plan/fired-events state, so a

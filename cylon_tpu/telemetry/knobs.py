@@ -223,8 +223,8 @@ declare("CYLON_PLAN_CACHE_MAX", 64, "int",
         "plan/fingerprint cache entries (0 disables the cache)", lo=0)
 declare("CYLON_OBS_PORT", 0, "int",
         "TCP port for the observability HTTP endpoint (/metrics, "
-        "/healthz, /queries, /slo) the QueryService starts on a "
-        "daemon thread; 0 disables it", lo=0)
+        "/healthz, /queries, /slo, /stats) the QueryService starts "
+        "on a daemon thread; 0 disables it", lo=0)
 
 # telemetry/slo.py (per-tenant service-level objectives)
 declare("CYLON_SLO_P95_MS", None, "float",
@@ -236,6 +236,27 @@ declare("CYLON_SLO_TARGET", 0.99, "float",
         "(the SLO target); the error budget is the allowed 1-target "
         "violation share, and burn events land in the flight "
         "admission ring", lo=0.0)
+
+# telemetry/stats.py (the query statistics warehouse)
+declare("CYLON_STATS_MIN_OBS", 3, "int",
+        "successful observations a fingerprint needs before its "
+        "measured EWMA informs admission estimates (below it the "
+        "static upper bound rules); also the drift-detection floor",
+        lo=1)
+declare("CYLON_STATS_SAFETY", 1.5, "float",
+        "headroom multiplier on the measured EWMA when it replaces a "
+        "static estimate: effective = min(static, ewma x safety) — "
+        "never above the static bound", lo=1.0)
+declare("CYLON_STATS_DRIFT_FACTOR", 4.0, "float",
+        "a new measurement deviating beyond this ratio from the EWMA "
+        "(either direction) fires cylon_stats_drift_total, records a "
+        "flight-ring event, evicts the plan-cache entry and resets "
+        "the learned stats to re-learn from the new regime", lo=1.0)
+declare("CYLON_STATS_PATH", None, "str",
+        "JSONL persistence path for the statistics warehouse: saved "
+        "on QueryService.close(), loaded on start() so a fresh "
+        "replica warm-starts its estimates; a corrupt file is "
+        "quarantined (renamed aside), never fatal")
 
 
 if __name__ == "__main__":  # pragma: no cover - doc regeneration

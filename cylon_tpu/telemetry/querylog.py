@@ -47,6 +47,7 @@ from . import export as _export
 from . import knobs as _knobs
 from . import slo as _slo
 from . import spans as _spans
+from . import stats as _stats
 
 # root span names that ARE queries (everything else a root hook sees —
 # eager op roots, marker spans — is not a query digest)
@@ -57,7 +58,7 @@ QUERY_ROOT_NAMES = ("plan.query",)
 # trees, so /queries can afford deeper history than forensics
 RING_FACTOR = 4
 
-DIGEST_SCHEMA_VERSION = 1
+DIGEST_SCHEMA_VERSION = 2   # v2: + est_bytes / est_source (PR 12)
 
 
 def _ring_size() -> int:
@@ -105,6 +106,13 @@ def digest(root) -> dict:
         if root.elapsed_ms is not None else None,
         "wait_s": a.get("wait_s"),
         "admission": a.get("admission"),
+        # the admission estimate + its provenance (static width x row
+        # bound vs measured-EWMA calibration): with these two fields
+        # beside the measured aggregates below, estimated-vs-actual is
+        # joinable OFFLINE from the JSONL alone — before them only the
+        # in-memory flight admission ring carried the estimate
+        "est_bytes": a.get("est_bytes"),
+        "est_source": a.get("est_source"),
         "plan_cache": a.get("plan_cache"),
         "plan_fp": a.get("plan_fp"),
         "shuffles": shuffles,
@@ -150,6 +158,14 @@ def _on_root_close(root) -> None:
     # has its own)
     _slo.observe(d["tenant"], d["exec_ms"] or 0.0,
                  error=root.error)
+    # ... and the statistics warehouse's: measured per-fingerprint
+    # truth (q-error, drift, stats-informed admission) accumulates at
+    # the same choke point where a finished query becomes operator-
+    # visible state (outside our lock — the store has its own)
+    try:
+        _stats.record_root(root, d)
+    except Exception:  # pragma: no cover - defensive
+        _spans.logger.exception("stats observation failed")
 
 
 # always on, like the flight recorder: the ring costs one deque append

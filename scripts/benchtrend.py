@@ -54,7 +54,12 @@ LOWER_IS_BETTER = {"compile.distinct_kernel_signatures",
                    # p95 submit→dispatch queue wait of the service
                    # pipeline (seconds): a rise is a scheduling/latency
                    # regression, a drop is the win
-                   "service_pipeline.wait_p95_s"}
+                   "service_pipeline.wait_p95_s",
+                   # worst per-kind estimate q-error p95 (1.0 =
+                   # estimates match measured truth): a rise means the
+                   # pre-flight estimator — or its stats calibration —
+                   # got worse at predicting reality
+                   "service_pipeline.qerror_p95"}
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -124,7 +129,10 @@ def flatten_metrics(parsed: Optional[dict]) -> Dict[str, float]:
                             ("groupby_rows_per_s", "groupby_rows_per_s"),
                             ("cache_hits", "cache_hits"),
                             ("queries_per_s", "queries_per_s"),
-                            ("wait_p95_s", "wait_p95_s")):
+                            ("wait_p95_s", "wait_p95_s"),
+                            ("qerror_p95", "qerror_p95"),
+                            ("stats_informed_admits",
+                             "stats_informed_admits")):
             v = _num(cfg.get(src))
             if v is not None:
                 out[f"{name}.{suffix}"] = v

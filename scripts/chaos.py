@@ -22,6 +22,15 @@ scripts/check.sh after the telemetry smoke gate):
   the clean run.
 * ``deadline``   — a ~zero ``CYLON_QUERY_DEADLINE_S`` surfaces as a
   typed ``CylonTimeoutError`` with a crash dump.
+* ``stats``      — the statistics-warehouse drill (PR 12): a CORRUPT
+  stats snapshot at service startup is quarantined (renamed aside,
+  typed ``CylonDataError`` event in the admission ring) and startup
+  proceeds clean; then an injected ~10x-rows drift on a learned
+  fingerprint fires ``cylon_stats_drift_total``, records a
+  ``stats_drift`` flight-ring event, EVICTS the plan-cache entry
+  (next optimize is a miss), and the next admission decision falls
+  back to ``est_source=static`` — while the drifted run's results
+  stay bit-identical to an uncached baseline.
 * ``service``    — the CONCURRENT drill (PR 7): 6 queries across two
   tenants plus one over-budget query submitted through the
   ``QueryService`` while a transient exchange fault is armed and the
@@ -63,7 +72,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 SCENARIOS = ("compile", "transient", "persistent", "shed", "degrade",
-             "deadline", "service")
+             "deadline", "stats", "service")
 
 
 class ChaosFailure(AssertionError):
@@ -348,6 +357,94 @@ def run_seed(seed: int, only=None) -> dict:
                seed, None)
         _leak_check(ledger, held, "deadline", seed, None)
         ran["deadline"] = {"dump": dumps[0]}
+
+    # -- stats: corrupt snapshot quarantined; drift evicts + reverts --
+    if wants("stats"):
+        from cylon_tpu.service import QueryService, plancache
+        from cylon_tpu.telemetry import querylog
+
+        def snap_counter(name):
+            return telemetry.metrics_snapshot().get(name, 0)
+
+        # (a) corrupted stats file at startup -> quarantine + clean
+        # start through the REAL startup path (QueryService.start)
+        sdir = tempfile.mkdtemp(prefix="cylon-chaos-stats-")
+        spath = os.path.join(sdir, "stats.jsonl")
+        with open(spath, "w") as f:
+            f.write("{corrupt" + "}" * (seed + 1) + "\n")
+        os.environ["CYLON_STATS_PATH"] = spath
+        q0 = snap_counter("cylon_stats_quarantine_total")
+        try:
+            svc = QueryService(name=f"chaos-stats-{seed}")
+            svc.close()
+        finally:
+            os.environ.pop("CYLON_STATS_PATH", None)
+        _check(snap_counter("cylon_stats_quarantine_total") == q0 + 1,
+               "corrupt stats snapshot was not quarantined", "stats",
+               seed, None)
+        _check(os.path.exists(spath + ".quarantine"),
+               "quarantined snapshot not preserved on disk", "stats",
+               seed, None)
+        quarantines = [d for d in flight.admissions()
+                       if d.get("action") == "stats_quarantine"]
+        _check(quarantines and
+               "CylonDataError" in quarantines[-1].get("error", ""),
+               f"no typed quarantine event in the admission ring: "
+               f"{quarantines[-1:]}", "stats", seed, None)
+
+        # (b) drift: learn a shape, then hit it with ~10x the rows
+        os.environ["CYLON_STATS_MIN_OBS"] = "2"
+        try:
+            sl, sr = _tables(ct, ctx, n, seed + 200)
+
+            def spipe(l, r):
+                return plan.scan(l).join(plan.scan(r), on="k") \
+                    .groupby("lt-2", ["rt-4"], ["min"])
+
+            for _ in range(2):
+                spipe(sl, sr).execute()
+            learned = querylog.recent()[-1]
+            _check(learned.get("est_source") == "measured",
+                   f"learned shape not measured-calibrated: "
+                   f"{learned.get('est_source')}", "stats", seed, None)
+            d0 = snap_counter("cylon_stats_drift_total")
+            m0 = snap_counter("cylon_plan_cache_misses_total")
+            bl, br = _tables(ct, ctx, n * 10, seed + 201)
+            drifted = spipe(bl, br).execute()
+            _check(snap_counter("cylon_stats_drift_total") > d0,
+                   "10x-rows run did not fire drift detection",
+                   "stats", seed, None)
+            drifts = [d for d in flight.admissions()
+                      if d.get("action") == "stats_drift"]
+            _check(bool(drifts), "no stats_drift event in the "
+                   "admission ring", "stats", seed, None)
+            # eviction: the next optimize of the learned shape MISSES
+            spipe(sl, sr).optimized()
+            _check(snap_counter("cylon_plan_cache_misses_total")
+                   == m0 + 1,
+                   "drift did not evict the cached plan template",
+                   "stats", seed, None)
+            # fallback: the next decision runs on static estimates
+            after = spipe(bl, br)
+            redo = after.execute()
+            _check(querylog.recent()[-1].get("est_source") == "static",
+                   f"post-drift admission did not fall back to static "
+                   f"estimates: {querylog.recent()[-1]}", "stats",
+                   seed, None)
+            # ...and none of it perturbs data: bit-identical to an
+            # uncached fresh execution
+            with plancache.disabled():
+                clean10 = spipe(bl, br).execute()
+            _check(_same_result(drifted, clean10)
+                   and _same_result(redo, clean10),
+                   "drifted/post-drift results diverge from the "
+                   "uncached baseline", "stats", seed, None)
+            del drifted, redo, clean10, sl, sr, bl, br
+        finally:
+            os.environ.pop("CYLON_STATS_MIN_OBS", None)
+        _leak_check(ledger, held, "stats", seed, None)
+        ran["stats"] = {"quarantine": quarantines[-1]["error"][:60],
+                        "drift": drifts[-1]["metric"]}
 
     # -- service: concurrent submissions, fault + shed among them -----
     if wants("service"):

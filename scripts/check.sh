@@ -92,12 +92,24 @@ echo "== observability smoke: scripts/smoke_obs.py =="
 # close() must leave no obs thread and zero ledger leaks
 python scripts/smoke_obs.py
 
+echo "== stats smoke: scripts/smoke_stats.py =="
+# the estimate-accuracy closed loop: a repeat-shape workload must
+# populate per-kind cylon_estimate_qerror series and the /stats
+# route; a query whose stat-free estimate sheds at first sight under
+# a clamped budget must be ADMITTED on repeat (est_source=measured in
+# digest + admission ring) once the shape is learned, while a fresh
+# shape still sheds on its static estimate; zero leaks, clean close
+python scripts/smoke_stats.py
+
 echo "== chaos drill: scripts/chaos.py --seeds 3 =="
 # seeded fault plans through the bench pipeline: transient faults must
 # retry to success ([RETRY] in EXPLAIN ANALYZE), persistent faults must
 # fail TYPED with a parseable crash dump naming the fault site, an
 # over-budget query must be shed or degraded by the admission
-# controller, a zero deadline must time out typed, and the CONCURRENT
+# controller, a zero deadline must time out typed, a corrupt stats
+# snapshot must be quarantined and an injected 10x-rows drift must
+# evict the cached plan + revert admission to static estimates with
+# bit-identical results (stats scenario), and the CONCURRENT
 # service drill (queries across two tenants with an injected exchange
 # fault + one over-budget query) must retry/shed without disturbing the
 # other queries' results — all deterministic per seed, zero ledger
