@@ -234,6 +234,100 @@ def bench_shuffle_wide(ctx, n_rows: int, iters: int) -> dict:
             "wall_s_best": _sig(best)}
 
 
+def bench_shuffle_pipeline(ctx, n_rows: int, iters: int) -> dict:
+    """The overlapped (chunked, double-buffered) exchange pipeline vs
+    the single-shot monolithic program, on the COUNTED padded route
+    (the distributed-op composition's shape — the count matrix is
+    fetched once, outside the timed region, exactly as the join/setop/
+    groupby consumers pay it). Records, per benchtrend's
+    LOWER_IS_BETTER gate: ``exchange_wall_s`` (the chunked pipeline's
+    best wall) and ``collective_launches`` (program dispatches per
+    chunked exchange with the fused partition+chunk-0 program — the
+    artifact also carries ``collective_launches_nofuse`` to show the
+    fusion win, strictly one launch fewer per exchange)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from cylon_tpu import telemetry
+    from cylon_tpu.parallel import shard as _shard
+    from cylon_tpu.parallel import shuffle as _shuffle
+
+    rng = np.random.default_rng(12)
+    world = max(ctx.get_world_size(), 1)
+    payload = {}
+    bytes_per_row = 0
+    for i in range(4):
+        payload[f"f{i}"] = _shard.pin(jnp.asarray(
+            rng.normal(size=n_rows).astype(np.float32)), ctx)
+        bytes_per_row += 4
+    payload["i0"] = _shard.pin(jnp.asarray(
+        rng.integers(0, 1 << 31, n_rows).astype(np.int64)), ctx)
+    bytes_per_row += 8
+    targets = _shard.pin(jnp.asarray(
+        rng.integers(0, world, n_rows).astype(np.int32)), ctx)
+    emit = _shard.pin(jnp.ones(n_rows, dtype=bool), ctx)
+    counts = np.asarray(jax.device_get(
+        _shuffle._count_fn(ctx.mesh)(targets, emit)))
+    # pick a chunk size that yields a >=4-deep pipeline at this scale
+    # (the default 64 MiB knob only chunks at production payloads)
+    _ok, block, _mb = _shuffle._padded_route(
+        counts, payload, world, ctx.memory_pool.comm_budget_bytes())
+    cbytes = max((world * bytes_per_row * block) // 4, 1 << 12)
+
+    def launches():
+        return telemetry.metrics_snapshot().get(
+            "cylon_collective_launches_total", 0)
+
+    def one(**kw):
+        out, _e, _cap, meta = _shuffle.exchange(
+            payload, targets, emit, ctx, counts=counts, **kw)
+        jax.device_get(out["f0"][:1])
+        return meta
+
+    old = {k: os.environ.get(k) for k in
+           ("CYLON_EXCHANGE_CHUNK_BYTES", "CYLON_EXCHANGE_OVERLAP")}
+    os.environ["CYLON_EXCHANGE_CHUNK_BYTES"] = str(cbytes)
+    os.environ["CYLON_EXCHANGE_OVERLAP"] = "1"
+    try:
+        meta = one()  # warmup + geometry
+        chunks = meta.get("chunks", 1)
+        l0 = launches()
+        one()
+        fused_launches = launches() - l0
+        l0 = launches()
+        one(fuse=False)
+        nofuse_launches = launches() - l0
+        chunked_s = _time(one, iters)
+        os.environ["CYLON_EXCHANGE_OVERLAP"] = "0"
+        single_s = _time(one, iters)
+    finally:
+        # restore BOTH knobs to their pre-config values: knobs read
+        # live, so a popped override would silently re-enable the
+        # default for every later suite config in this process
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    gbps = n_rows * bytes_per_row / chunked_s / 1e9 / world
+    return {
+        "exchange_wall_s": _sig(chunked_s),
+        "single_shot_wall_s": _sig(single_s),
+        "speedup_vs_single_shot": _sig(single_s / chunked_s, 4)
+        if chunked_s else 0.0,
+        "chunks": int(chunks),
+        "overlap_ratio": _sig((fused_launches - 1) / fused_launches, 4)
+        if fused_launches else 0.0,
+        "collective_launches": int(fused_launches),
+        "collective_launches_nofuse": int(nofuse_launches),
+        "gbps_per_chip": _sig(gbps, 4),
+        "rows_per_s_per_chip": n_rows / chunked_s / world,
+        "bytes_per_row": bytes_per_row,
+    }
+
+
 def bench_groupby(ctx, n_rows: int, iters: int) -> dict:
     import cylon_tpu as ct
 
@@ -735,6 +829,8 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
              lambda: bench_dist_sort(ctx, n_rows, iters)),
             ("shuffle_wide",
              lambda: bench_shuffle_wide(ctx, n_rows, iters)),
+            ("shuffle_pipeline",
+             lambda: bench_shuffle_pipeline(ctx, n_rows, iters)),
             ("hbm_blocked_join",
              lambda: bench_hbm_blocked_join(ctx, n_rows * 12,
                                             n_rows * 3)),

@@ -237,6 +237,27 @@ def default_entry_points() -> List[EntryPoint]:
             lambda m: (payload(),) + rows(i32, b),
             factory="_exchange_fn"),
         EntryPoint(
+            "exchange_partition", sh,
+            lambda m: S(m)._exchange_partition_fn(m, 16, 8),
+            lambda m: (payload(),) + rows(i32, b),
+            factory="_exchange_partition_fn"),
+        EntryPoint(
+            "exchange_chunk_first", sh,
+            lambda m: S(m)._exchange_chunk_first_fn(m, 16, 8),
+            lambda m: (payload(),) + rows(i32, b),
+            factory="_exchange_chunk_first_fn"),
+        EntryPoint(
+            # operands: chunk-padded sorted leaves (rows + world*cb),
+            # per-shard start offsets, the [world*block] accumulator,
+            # and the replicated chunk-index scalar
+            "exchange_chunk", sh,
+            lambda m: S(m)._exchange_chunk_fn(m, 16, 8),
+            lambda m: ({"d0": _sds((96,), i32), "v0": _sds((96,), b)},
+                       _sds(CI, i32),
+                       {"d0": _sds((256,), i32), "v0": _sds((256,), b)},
+                       _sds((), i32)),
+            factory="_exchange_chunk_fn"),
+        EntryPoint(
             "string_hash", do, lambda m: D(m)._string_hash_fn(m, 4),
             lambda m: vb(), factory="_string_hash_fn"),
         EntryPoint(

@@ -49,8 +49,10 @@ except ImportError:  # pragma: no cover
 from ..context import CylonContext
 from ..resilience import inject as _inject
 from ..resilience import retry as _retry
+from ..telemetry import REGISTRY as _REGISTRY
 from ..telemetry import counted_cache, counter as _counter, \
     phase as _phase, record_host_sync as _host_sync, span as _span
+from ..telemetry import knobs as _knobs
 from ..telemetry import skew as _skew
 from ..util import pow2 as _pow2, pow2_floor as _pow2_floor
 
@@ -63,6 +65,18 @@ from ..util import pow2 as _pow2, pow2_floor as _pow2_floor
 # a 1-wide v5e mesh — round count, not block memory, was the binding
 # constraint.)
 MAX_BLOCK = 1 << 22
+
+# Chunk-count ceiling for the overlapped (chunked) padded exchange: the
+# chunk block is floored so one exchange never fans out into more than
+# this many pipeline programs — past ~64 the per-dispatch fixed cost
+# dwarfs any remaining overlap win (the 1<<16 MAX_BLOCK measurement
+# above is the same lesson: round count, not block memory, binds).
+MAX_CHUNKS = 64
+
+# cylon_exchange_overlap_ratio buckets: fraction of an exchange's
+# programs issued while earlier chunk work was still in flight
+# ((programs-1)/programs) — 0.0 is single-shot, ->1.0 is a deep pipeline
+OVERLAP_BUCKETS = (0.0, 0.25, 0.5, 0.75, 0.875, 0.9375, 1.0)
 
 
 def replicated_gather(x, axis: str, world: int):
@@ -255,16 +269,30 @@ def _padded_body_w1(axis, block, payload, targets, emit):
     return jax.tree.unflatten(treedef, list(outs)), new_emit, counts_in
 
 
+def _padded_partition(axis, world, block, payload, targets, emit):
+    """The shared partition prefix of BOTH padded-mode bodies (the
+    single-shot program and the chunked pipeline): bucket sort, device
+    counts exchange, per-target start offsets and the final emit mask.
+    ONE copy on purpose — the chunked path's bit-identity with the
+    single-shot program is structural, not two texts kept in sync."""
+    cap_out = world * block
+    sorted_leaves, counts_out, start = _bucket_sort(
+        payload, targets, emit, world)
+    counts_in = jax.lax.all_to_all(counts_out, axis, split_axis=0,
+                                   concat_axis=0, tiled=True)
+    pos = jnp.arange(cap_out, dtype=jnp.int32)
+    new_emit = (pos % block) < jnp.take(counts_in, pos // block)
+    return sorted_leaves, counts_in, start, new_emit
+
+
 def _padded_body(axis, world, block, payload, targets, emit):
     """The padded-mode exchange as a pure function of per-shard values —
     shared by the single and the PAIR program builders."""
     if world == 1:
         return _padded_body_w1(axis, block, payload, targets, emit)
     cap_out = world * block
-    sorted_leaves, counts_out, start = _bucket_sort(
-        payload, targets, emit, world)
-    counts_in = jax.lax.all_to_all(counts_out, axis, split_axis=0,
-                                   concat_axis=0, tiled=True)
+    sorted_leaves, counts_in, start, new_emit = _padded_partition(
+        axis, world, block, payload, targets, emit)
 
     def one(xs):
         pad = jnp.zeros((block,) + xs.shape[1:], xs.dtype)
@@ -275,8 +303,6 @@ def _padded_body(axis, world, block, payload, targets, emit):
         return recv.reshape((cap_out,) + xs.shape[1:])
 
     outs = jax.tree.map(one, sorted_leaves)
-    pos = jnp.arange(cap_out, dtype=jnp.int32)
-    new_emit = (pos % block) < jnp.take(counts_in, pos // block)
     return outs, new_emit, counts_in
 
 
@@ -296,6 +322,220 @@ def _exchange_padded_fn(mesh, block: int):
 
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec))
+
+
+# ---------------------------------------------------------------------------
+# the chunked, double-buffered padded exchange (overlapped blockwise
+# pipeline): the padded payload splits into CYLON_EXCHANGE_CHUNK_BYTES-
+# sized blocks, and chunk N+1's all_to_all is dispatched while chunk N's
+# received rows are still being compacted into the output — JAX async
+# dispatch is the overlap engine, so the host never waits between
+# chunks. Peak comm-buffer HBM per leaf drops from 2*W*block (the
+# single-shot send+recv stacks) to 2*W*chunk_block: the live pair is
+# one in-flight chunk's buffers plus the (donated, reused) accumulator.
+# Chunk geometry derives from the count matrix the host already fetched
+# for block geometry — zero new host syncs.
+# ---------------------------------------------------------------------------
+
+
+def _chunk_plan(block: int, world: int, bytes_per_row: int):
+    """(chunk_block, chunks) for a padded exchange with per-(src,dst)
+    ``block``; chunks == 1 means single-shot. Pure host arithmetic over
+    already-known geometry. The chunk block is pow2-floored (its value
+    keys compiled chunk programs — 1 per octave, specialization-clean)
+    and floored again so the pipeline never exceeds MAX_CHUNKS
+    programs."""
+    if not _knobs.get("CYLON_EXCHANGE_OVERLAP"):
+        return block, 1
+    target = int(_knobs.get("CYLON_EXCHANGE_CHUNK_BYTES"))
+    per_slot = max(int(bytes_per_row), 1) * max(world, 1)
+    cb = _pow2_floor(max(target // per_slot, 1))
+    cb = max(cb, _pow2_floor(max(block // MAX_CHUNKS, 1)))
+    if cb >= block:
+        return block, 1
+    return cb, -(-block // cb)
+
+
+def _chunk_write(axis, world, block, cb, xs, start, out, o):
+    """Move ONE chunk of one leaf: slice rows [start[t]+o, +cb) per
+    target (contiguous — the payload is bucket-sorted), all_to_all,
+    land source s's rows at the STATIC padded slot s*block + o. When
+    the chunk block divides the block the landing is a memcpy-class
+    dynamic_update_slice; a remainder chunk (non-pow2 geometry, only
+    reachable through forced test plans) falls back to a dropping
+    scatter so out-of-block rows vanish instead of wrapping."""
+    send = _send_block(xs, start, o, cb, world)
+    recv = jax.lax.all_to_all(send, axis, split_axis=0,
+                              concat_axis=0, tiled=False)
+    if block % cb == 0:
+        out2d = out.reshape((world, block) + xs.shape[1:])
+        out2d = jax.lax.dynamic_update_slice_in_dim(out2d, recv, o,
+                                                    axis=1)
+        return out2d.reshape((world * block,) + xs.shape[1:])
+    biota = jnp.arange(cb, dtype=jnp.int32)
+    pos = (jnp.arange(world, dtype=jnp.int32) * block)[:, None] \
+        + o + biota[None, :]
+    valid = (o + biota) < block
+    psafe = jnp.where(valid[None, :], pos, world * block).reshape(-1)
+    flat = recv.reshape((world * cb,) + xs.shape[1:])
+    return out.at[psafe].set(flat, mode="drop")
+
+
+def _partition_body(axis, world, block, cb, payload, targets, emit,
+                    first_chunk: bool):
+    """The partition phase of the chunked exchange as a pure per-shard
+    function: bucket sort, device counts exchange, chunk-padded sorted
+    leaves, zeroed output accumulators and the final emit mask —
+    everything the per-chunk programs consume. ``first_chunk`` folds
+    chunk 0's exchange+compaction in (the fused form)."""
+    cap_out = world * block
+    sorted_leaves, counts_in, start, new_emit = _padded_partition(
+        axis, world, block, payload, targets, emit)
+    padded = jax.tree.map(
+        lambda x: jnp.concatenate(
+            [x, jnp.zeros((cb,) + x.shape[1:], x.dtype)]),
+        sorted_leaves)
+    _to_varying = _to_varying_fn(axis)
+    out0 = jax.tree.map(
+        lambda x: _to_varying(jnp.zeros((cap_out,) + x.shape[1:],
+                                        x.dtype)), payload)
+    if first_chunk:
+        out0 = jax.tree.map(
+            lambda xs, ob: _chunk_write(axis, world, block, cb, xs,
+                                        start, ob, 0),
+            padded, out0)
+    return padded, start, counts_in, new_emit, out0
+
+
+@counted_cache
+def _exchange_partition_fn(mesh, block: int, chunk_block: int):
+    """UNFUSED partition program of the chunked exchange (no chunk 0):
+    kept as a real dispatchable program so the profiler and the
+    shuffle_pipeline bench can measure the fusion win of
+    `_exchange_chunk_first_fn` against it — with fusion a C-chunk
+    exchange costs C program launches, without it C+1."""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+
+    def kernel(payload, targets, emit):
+        return _partition_body(axis, world, block, chunk_block,
+                               payload, targets, emit, first_chunk=False)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec))
+
+
+@counted_cache
+def _exchange_chunk_first_fn(mesh, block: int, chunk_block: int):
+    """FUSED partition+exchange program — the single-table analog of
+    the `_exchange_padded_pair_fn` trick (two stages in ONE compiled
+    program, one dispatch where two would do): the partition body with
+    chunk 0's all_to_all+compaction folded in, so XLA schedules the
+    bucket sort, the counts exchange and the first payload collective
+    together and `cylon_collective_launches_total` drops by one per
+    chunked exchange."""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+
+    def kernel(payload, targets, emit):
+        return _partition_body(axis, world, block, chunk_block,
+                               payload, targets, emit, first_chunk=True)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec))
+
+
+@counted_cache
+def _exchange_chunk_fn(mesh, block: int, chunk_block: int):
+    """One pipeline chunk: slice, all_to_all, compact at the static
+    padded slots. The chunk index ``k`` rides as a DEVICE operand
+    (replicated scalar), so every chunk of every exchange with this
+    geometry shares ONE compiled program — chunk count never enters a
+    cache key. The output accumulator is donated on TPU: the pipeline's
+    live buffers are the in-flight chunk's send/recv stacks plus one
+    accumulator (the double buffer), not one fresh [cap_out] copy per
+    chunk. (Donation is a no-op on host backends, which do not
+    implement it.)"""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+
+    def kernel(padded, start, out, k):
+        o = k.astype(jnp.int32) * chunk_block
+        return jax.tree.map(
+            lambda xs, ob: _chunk_write(axis, world, block, chunk_block,
+                                        xs, start, ob, o),
+            padded, out)
+
+    donate = (2,) if mesh.devices.flat[0].platform == "tpu" else ()
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(spec, spec, spec, P()),
+                             out_specs=spec),
+                   donate_argnums=donate)
+
+
+def _dispatch_chunked(ctx: CylonContext, block: int, cb: int,
+                      chunks: int, payload, targets, emit, fuse: bool):
+    """Launch the chunked pipeline: one partition program (with chunk 0
+    folded in when ``fuse``), then one chunk program per remaining
+    chunk — dispatched back to back WITHOUT waiting, so chunk N+1's
+    all_to_all runs while chunk N's received rows are compacted (and
+    while the consumer's local kernels on already-landed rows queue
+    behind them). Every dispatch runs under the per-chunk retry policy;
+    re-dispatch is idempotent because the chaos injector fires BEFORE
+    the program consumes its (donated) buffers. Returns (outs,
+    new_emit, counts_in, programs_launched)."""
+    mesh = ctx.mesh
+    if fuse:
+        padded, start, counts_in, new_emit, outs = _launch_exchange(
+            lambda: _exchange_chunk_first_fn(mesh, block, cb)(
+                payload, targets, emit))
+        k0, programs = 1, chunks
+    else:
+        padded, start, counts_in, new_emit, outs = _launch_exchange(
+            lambda: _exchange_partition_fn(mesh, block, cb)(
+                payload, targets, emit))
+        k0, programs = 0, chunks + 1
+    step = _exchange_chunk_fn(mesh, block, cb)
+    for k in range(k0, chunks):
+        karr = np.int32(k)
+
+        def attempt(karr=karr, k=k):
+            # donation caveat: a faulted dispatch that already consumed
+            # the donated accumulator (possible only on TPU — donation
+            # is a no-op on host backends) would make a plain
+            # re-dispatch fail hard on a deleted buffer; a retry
+            # attempt therefore rebuilds the pipeline state from the
+            # (never-donated) payload and replays the landed chunks
+            # before re-dispatching — idempotent recovery either way
+            nonlocal padded, start, counts_in, new_emit, outs
+            leaf = next(iter(jax.tree.leaves(outs)), None)
+            if leaf is not None and \
+                    getattr(leaf, "is_deleted", lambda: False)():
+                padded, start, counts_in, new_emit, outs = \
+                    _exchange_partition_fn(mesh, block, cb)(
+                        payload, targets, emit)
+                for j in range(k):
+                    outs = step(padded, start, outs, np.int32(j))
+            return step(padded, start, outs, karr)
+
+        outs = _launch_exchange(attempt)
+    return outs, new_emit, counts_in, programs
+
+
+def _record_chunked(sp, chunks: int, cb: int, programs: int) -> None:
+    """Chunk-pipeline observability: per-exchange span attrs plus the
+    cylon_exchange_chunks_total counter and the overlap-ratio histogram
+    ((programs-1)/programs — the fraction of the pipeline's programs
+    issued while earlier chunk work was still in flight)."""
+    ratio = (programs - 1) / programs if programs else 0.0
+    sp.set(chunks=chunks, chunk_block=cb,
+           overlap_ratio=round(ratio, 4))
+    _counter("cylon_exchange_chunks_total").inc(chunks)
+    _REGISTRY.histogram("cylon_exchange_overlap_ratio",
+                        buckets=OVERLAP_BUCKETS).observe(ratio)
 
 
 @counted_cache
@@ -357,6 +597,16 @@ def exchange_pair(payload1, targets1, emit1, counts1,
                                   buffer_factor=8)
     ok2, b2, _mb2 = _padded_route(counts2, payload2, world, budget,
                                   buffer_factor=8)
+    if ok1 and ok2 and (
+            _chunk_plan(b1, world, _payload_row_bytes(payload1))[1] > 1
+            or _chunk_plan(b2, world,
+                           _payload_row_bytes(payload2))[1] > 1):
+        # either side is big enough to chunk: the overlapped pipeline
+        # (each side chunked through exchange(), counts already fetched)
+        # beats the monolithic pair program whose send+recv stacks for
+        # BOTH tables would be live at once
+        return (exchange(payload1, targets1, emit1, ctx, counts=counts1),
+                exchange(payload2, targets2, emit2, ctx, counts=counts2))
     if ok1 and ok2:
         seq = ctx.get_next_sequence()
         rows = (int(counts1.sum()) if counts1 is not None else 0) \
@@ -562,7 +812,7 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
              emit: jnp.ndarray, ctx: CylonContext,
              max_block: Optional[int] = None,
              counts: Optional[np.ndarray] = None,
-             dense: bool = False
+             dense: bool = False, fuse: bool = True
              ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, int, dict]:
     """Shuffle a pytree of row-sharded per-row arrays to their target shards.
 
@@ -582,7 +832,13 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
 
     meta = {"mode", "block", "counts_in"} — counts_in is the [world*W]
     sharded per-source receive-count matrix (each shard's own [W] slice),
-    consumed by the varbytes word/row layout reconciliation.
+    consumed by the varbytes word/row layout reconciliation. Padded-mode
+    exchanges whose payload exceeds CYLON_EXCHANGE_CHUNK_BYTES run as
+    the chunked, double-buffered pipeline (meta gains ``chunks``;
+    ``CYLON_EXCHANGE_OVERLAP=0`` restores the single-shot program, and
+    the two paths are bit-identical on every live row). ``fuse`` folds
+    the partition program into chunk 0 (on by default; the bench's
+    launch-count comparison is the only caller that turns it off).
     ``max_block`` caps the per-round blockwise block size.
     """
     world = ctx.get_world_size()
@@ -634,15 +890,26 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
     cap_compact = _pow2(recv_max)
     rows_live = int(counts.sum()) if counts.size else 0
     nbytes = _payload_nbytes(payload)
+    row_bytes = _payload_row_bytes(payload)
     # skew observability rides the ALREADY-FETCHED count matrix: zero
     # extra device→host transfers (None on a 1-wide mesh)
-    skew_stats = _skew.observe_exchange(counts, _payload_row_bytes(payload))
+    skew_stats = _skew.observe_exchange(counts, row_bytes)
     with _span("shuffle.exchange", seq, world=world,
                mode="padded" if padded_ok else "compact",
                rows=rows_live, bytes_moved=nbytes) as sp:
         if skew_stats is not None:
             sp.set(**skew_stats.span_attrs())
         if padded_ok:
+            cb, chunks = _chunk_plan(block_p, world, row_bytes)
+            if chunks > 1:
+                out, new_emit, counts_in, programs = _dispatch_chunked(
+                    ctx, block_p, cb, chunks, payload, targets, emit,
+                    fuse)
+                _record_chunked(sp, chunks, cb, programs)
+                _record_exchange(rows_live, nbytes, programs)
+                return out, new_emit, cap_padded, {
+                    "mode": "padded", "block": block_p,
+                    "counts_in": counts_in, "chunks": chunks}
             out, new_emit, counts_in = _launch_exchange(
                 lambda: _exchange_padded_fn(
                     ctx.mesh, block_p)(payload, targets, emit))

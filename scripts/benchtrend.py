@@ -59,7 +59,17 @@ LOWER_IS_BETTER = {"compile.distinct_kernel_signatures",
                    # estimates match measured truth): a rise means the
                    # pre-flight estimator — or its stats calibration —
                    # got worse at predicting reality
-                   "service_pipeline.qerror_p95"}
+                   "service_pipeline.qerror_p95",
+                   # the overlapped exchange pipeline's wall clock and
+                   # its per-exchange collective-program dispatches
+                   # (the fused partition+chunk-0 program keeps the
+                   # count at C; a rise means chunking got slower or
+                   # the fusion regressed). CPU-fallback caveat: these
+                   # gate like every metric — only against a SAME-
+                   # backend reference, so they enter the gate for
+                   # real once TPU rounds resume (r05 is cpu-fallback)
+                   "shuffle_pipeline.exchange_wall_s",
+                   "shuffle_pipeline.collective_launches"}
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -125,6 +135,9 @@ def flatten_metrics(parsed: Optional[dict]) -> Dict[str, float]:
         for src, suffix in (("rows_per_s_per_chip", "rows_per_s"),
                             ("gbps_per_chip", "gbps"),
                             ("speedup", "speedup"),
+                            ("exchange_wall_s", "exchange_wall_s"),
+                            ("collective_launches",
+                             "collective_launches"),
                             ("join_rows_per_s", "join_rows_per_s"),
                             ("groupby_rows_per_s", "groupby_rows_per_s"),
                             ("cache_hits", "cache_hits"),

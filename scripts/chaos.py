@@ -71,8 +71,8 @@ os.environ.setdefault("CYLON_RETRY_BACKOFF_S", "0.001")
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-SCENARIOS = ("compile", "transient", "persistent", "shed", "degrade",
-             "deadline", "stats", "service")
+SCENARIOS = ("compile", "transient", "overlap", "persistent", "shed",
+             "degrade", "deadline", "stats", "service")
 
 
 class ChaosFailure(AssertionError):
@@ -229,6 +229,43 @@ def run_seed(seed: int, only=None) -> dict:
         _leak_check(ledger, held, "transient", seed, fp)
         ran["transient"] = {"retries": _retries(telemetry) - r0,
                             "nth": nth}
+
+    # -- overlap: transient fault mid-chunk-stream of the chunked
+    # (double-buffered) exchange pipeline — the faulted chunk retries
+    # idempotently and the result bit-matches the single-shot baseline
+    if wants("overlap"):
+        nth = 2 + seed % 3
+        fp = f"exchange:{nth}:transient"
+        os.environ["CYLON_EXCHANGE_CHUNK_BYTES"] = "4096"
+        inject.arm(fp)
+        r0 = _retries(telemetry)
+        c0 = telemetry.metrics_snapshot().get(
+            "cylon_exchange_chunks_total", 0)
+        p = _pipe(plan, left, right)
+        try:
+            txt = p.explain(analyze=True)
+            result = p.execute()
+        finally:
+            inject.disarm()
+            os.environ.pop("CYLON_EXCHANGE_CHUNK_BYTES", None)
+        chunks_moved = telemetry.metrics_snapshot().get(
+            "cylon_exchange_chunks_total", 0) - c0
+        _check(chunks_moved > 0,
+               "forced chunk plan did not engage the chunked pipeline",
+               "overlap", seed, fp)
+        _check(_retries(telemetry) > r0,
+               "no retry recorded for the fault mid-chunk-stream",
+               "overlap", seed, fp)
+        _check("[RETRY" in txt,
+               f"no [RETRY marker in EXPLAIN ANALYZE:\n{txt}",
+               "overlap", seed, fp)
+        _check(_same_result(result, baseline),
+               "chunked pipeline result diverges from the single-shot "
+               "baseline after mid-stream retry", "overlap", seed, fp)
+        del result
+        _leak_check(ledger, held, "overlap", seed, fp)
+        ran["overlap"] = {"retries": _retries(telemetry) - r0,
+                          "nth": nth, "chunks": chunks_moved}
 
     # -- persistent: every exchange attempt faults -> typed + dump ----
     if wants("persistent"):

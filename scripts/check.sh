@@ -103,7 +103,10 @@ python scripts/smoke_stats.py
 
 echo "== chaos drill: scripts/chaos.py --seeds 3 =="
 # seeded fault plans through the bench pipeline: transient faults must
-# retry to success ([RETRY] in EXPLAIN ANALYZE), persistent faults must
+# retry to success ([RETRY] in EXPLAIN ANALYZE) — including a fault
+# MID-CHUNK-STREAM of the overlapped (chunked) exchange pipeline, whose
+# retried result must bit-match the single-shot baseline with zero new
+# ledger leaks (the overlap scenario) — persistent faults must
 # fail TYPED with a parseable crash dump naming the fault site, an
 # over-budget query must be shed or degraded by the admission
 # controller, a zero deadline must time out typed, a corrupt stats

@@ -15,7 +15,13 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # older jax spells it as an XLA boot flag
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+            " --xla_force_host_platform_device_count=8"
 jax.config.update("jax_enable_x64", True)
 
 import pandas as pd  # noqa: E402
@@ -65,6 +71,15 @@ def one_case(seed):
     jt = rng.choice(["inner", "left", "right", "outer"])
     force_vb = bool(rng.integers(0, 2)) and "str" in kind
     with_nulls = bool(rng.integers(0, 2)) and "str" in kind
+
+    # randomly toggle the overlapped (chunked) exchange: with a tiny
+    # chunk target every padded exchange runs the chunked pipeline,
+    # which must stay bit-identical to the single-shot program on all
+    # of the distributed-vs-local comparisons below
+    overlap = bool(rng.integers(0, 2))
+    os.environ["CYLON_EXCHANGE_OVERLAP"] = "1" if overlap else "0"
+    if overlap:
+        os.environ["CYLON_EXCHANGE_CHUNK_BYTES"] = "4096"
 
     old = _strings.DICT_MAX_VOCAB
     if force_vb:
@@ -130,7 +145,9 @@ def one_case(seed):
         assert kd == kl, f"sort seed={seed}"
     finally:
         _strings.DICT_MAX_VOCAB = old
-    return kind, jt, force_vb
+        os.environ.pop("CYLON_EXCHANGE_OVERLAP", None)
+        os.environ.pop("CYLON_EXCHANGE_CHUNK_BYTES", None)
+    return kind, jt, force_vb, overlap
 
 
 def main(n_cases, base):
@@ -138,8 +155,9 @@ def main(n_cases, base):
     for i in range(n_cases):
         seed = base + i
         try:
-            kind, jt, fv = one_case(seed)
-            print(f"case {seed}: ok ({kind}, {jt}, vb={fv})", flush=True)
+            kind, jt, fv, ov = one_case(seed)
+            print(f"case {seed}: ok ({kind}, {jt}, vb={fv}, "
+                  f"overlap={ov})", flush=True)
         except AssertionError as e:
             bad += 1
             print(f"case {seed}: FAIL {e}", flush=True)

@@ -35,3 +35,7 @@ def _rogue_kernel_fn(mesh):  # SEEDED: collectives/uncataloged-factory
 def _host_helper_fn(axis):  # cylint: disable=collectives/uncataloged-factory
     # intentional exclusion: plain host callable, not a jitted program
     return lambda x: x
+
+
+def _chunk_rogue_fn(mesh, block, chunk_block):  # SEEDED: collectives/uncataloged-factory (chunked-path control)
+    return mesh

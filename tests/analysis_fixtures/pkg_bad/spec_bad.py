@@ -70,3 +70,25 @@ def run_ops(mesh, counts, opaque):
     _closes_over_key_fn(mesh, 4)
     n = int(os.environ.get("FIXTURE_ROWS", "64"))
     _raw_mat_fn(mesh, n)  # cylint: disable=specialization/unbounded-key — suppression-count control (env-read source)
+
+
+def pow2_floor(n):
+    """Recognized bucketing helper (name-level for fixture trees)."""
+    return 1 << (max(int(n), 1).bit_length() - 1)
+
+
+@counted_cache
+def _chunk_exchange_fn(mesh, block: int, chunk_block: int):
+    """Chunked-exchange-shaped factory: BOTH capacity params key
+    compiled programs, so both must arrive bucketed."""
+    def kernel(x):
+        return x
+
+    return jax.jit(kernel)
+
+
+def run_chunked(mesh, counts):
+    block = bucket_cap(int(np.asarray(jax.device_get(counts)).max()))
+    _chunk_exchange_fn(mesh, block, pow2_floor(block // 4))  # clean
+    cb = int(np.asarray(jax.device_get(counts)).sum())
+    _chunk_exchange_fn(mesh, block, cb)     # SEEDED: unbucketed chunk block

@@ -244,8 +244,12 @@ def test_collectives_uncataloged_factory_fixture():
     got = {(f.path, f.rule) for f in res.findings}
     assert got == {("parallel/dist_ops.py",
                     "collectives/uncataloged-factory")}, res.format_text()
-    assert len(res.findings) == 1
-    assert "_rogue_kernel_fn" in res.findings[0].message
+    assert len(res.findings) == 2
+    names = " ".join(f.message for f in res.findings)
+    assert "_rogue_kernel_fn" in names
+    # the chunked-exchange-shaped factory is swept the same way: a new
+    # chunk program outside the catalog is a finding, not a note
+    assert "_chunk_rogue_fn" in names
     # _host_helper_fn opted out on its def line — suppressed, visible
     assert res.suppressed == 1
 
@@ -477,6 +481,10 @@ def test_specialization_fixture_reports_exactly_seeded():
         ("spec_bad.py", 67, "specialization/unbucketed-capacity"),
         ("spec_bad.py", 68, "specialization/unbucketed-capacity"),
         ("spec_bad.py", 69, "specialization/unbounded-key"),
+        # the chunked-exchange-shaped factory: the bucketed block +
+        # pow2_floor chunk-block call stays clean, the raw runtime
+        # chunk block is a finding
+        ("spec_bad.py", 94, "specialization/unbucketed-capacity"),
     }, res.format_text()
     # the reasoned per-line disable on the env-sourced cap counted
     assert res.suppressed == 1

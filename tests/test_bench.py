@@ -44,10 +44,18 @@ def test_full_suite_small(local_ctx):
     suite = res["detail"]["suite"]
     for name in ("groupby_agg", "global_sort", "set_union", "q5_pipeline",
                  "string_join", "dist_string_join", "dist_sort", "dist_union",
-                 "shuffle_wide", "hbm_blocked_join", "pandas_reference",
-                 "service_pipeline"):
+                 "shuffle_wide", "shuffle_pipeline", "hbm_blocked_join",
+                 "pandas_reference", "service_pipeline"):
         assert name in suite, f"missing config {name}"
         assert "error" not in suite[name], (name, suite[name])
+    # the overlapped-exchange config must demonstrate the fusion win
+    # (strictly fewer collective launches with the fused partition+
+    # chunk-0 program) and record the pipeline geometry
+    sp = suite["shuffle_pipeline"]
+    assert sp["chunks"] > 1
+    assert sp["collective_launches"] < sp["collective_launches_nofuse"]
+    assert 0.0 < sp["overlap_ratio"] < 1.0
+    assert sp["exchange_wall_s"] > 0
     json.dumps(res)
 
 

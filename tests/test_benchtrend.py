@@ -60,6 +60,39 @@ def test_flatten_metrics_shapes():
     assert flat["compile.distinct_kernel_signatures"] == 37
 
 
+def test_shuffle_pipeline_metrics_flatten_and_gate_lower(tmp_path):
+    """The overlapped-exchange metrics flatten (wall + launch count)
+    and gate LOWER_IS_BETTER: a round that halves the exchange wall
+    passes, one that rebloats the launch count past the threshold
+    fails."""
+    flat = benchtrend.flatten_metrics(_artifact(
+        1e6, suite={"shuffle_pipeline": {"exchange_wall_s": 0.8,
+                                         "collective_launches": 4,
+                                         "gbps_per_chip": 2.0}}))
+    assert flat["shuffle_pipeline.exchange_wall_s"] == 0.8
+    assert flat["shuffle_pipeline.collective_launches"] == 4
+    assert flat["shuffle_pipeline.gbps"] == 2.0
+    assert "shuffle_pipeline.exchange_wall_s" in \
+        benchtrend.LOWER_IS_BETTER
+    assert "shuffle_pipeline.collective_launches" in \
+        benchtrend.LOWER_IS_BETTER
+    win = _write_rounds(tmp_path, {
+        1: _artifact(1e6, suite={"shuffle_pipeline": {
+            "exchange_wall_s": 0.8, "collective_launches": 8}}),
+        2: _artifact(1e6, suite={"shuffle_pipeline": {
+            "exchange_wall_s": 0.4, "collective_launches": 4}})})
+    assert benchtrend.find_regressions(benchtrend.load_rounds(win)) == []
+    lose = _write_rounds(tmp_path, {
+        1: _artifact(1e6, suite={"shuffle_pipeline": {
+            "exchange_wall_s": 0.4, "collective_launches": 4}}),
+        2: _artifact(1e6, suite={"shuffle_pipeline": {
+            "exchange_wall_s": 0.8, "collective_launches": 8}})})
+    regs = {m for m, *_ in benchtrend.find_regressions(
+        benchtrend.load_rounds(lose))}
+    assert "shuffle_pipeline.exchange_wall_s" in regs
+    assert "shuffle_pipeline.collective_launches" in regs
+
+
 def test_signature_count_is_judged_lower_is_better(tmp_path):
     """The recompile-cardinality metric inverts the gate: a round that
     HALVES distinct signatures (the bucketing win) passes, a round

@@ -1,0 +1,279 @@
+"""The overlapped (chunked, double-buffered) exchange pipeline.
+
+Core contract: the chunked path is BIT-IDENTICAL to the single-shot
+padded program on every live row — same emit mask, same counts_in, same
+capacity — across chunk counts (1, 2, deep, odd remainder, chunk >
+payload), under per-chunk transient faults, and end to end through the
+distributed-op compositions. The fused partition+chunk-0 program must
+launch strictly fewer collective programs than the unfused form.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import telemetry
+from cylon_tpu.parallel import shard as _shard
+from cylon_tpu.parallel import shuffle as _shuffle
+from cylon_tpu.resilience import inject as _inject
+
+
+def _mk_exchange_inputs(ctx, n, seed=0, live=0.85):
+    import jax.numpy as jnp
+
+    world = ctx.get_world_size()
+    rng = np.random.default_rng(seed)
+    payload = {
+        "a": _shard.pin(jnp.asarray(
+            rng.integers(0, 1 << 30, n).astype(np.int32)), ctx),
+        "b": _shard.pin(jnp.asarray(
+            rng.normal(size=n).astype(np.float32)), ctx),
+    }
+    targets = _shard.pin(jnp.asarray(
+        rng.integers(0, world, n).astype(np.int32)), ctx)
+    emit = _shard.pin(jnp.asarray(rng.random(n) < live), ctx)
+    return payload, targets, emit
+
+
+def _counts(ctx, targets, emit):
+    import jax
+
+    return np.asarray(jax.device_get(
+        _shuffle._count_fn(ctx.mesh)(targets, emit)))
+
+
+def _run(ctx, payload, targets, emit, counts, **kw):
+    return _shuffle.exchange(payload, targets, emit, ctx, counts=counts,
+                             **kw)
+
+
+def _assert_bit_identical(base, out):
+    o0, e0, c0, m0 = base
+    o1, e1, c1, m1 = out
+    assert c0 == c1
+    e0h, e1h = np.asarray(e0), np.asarray(e1)
+    assert np.array_equal(e0h, e1h)
+    assert np.array_equal(np.asarray(m0["counts_in"]),
+                          np.asarray(m1["counts_in"]))
+    assert m0["mode"] == m1["mode"] == "padded"
+    assert m0["block"] == m1["block"]
+    for k in o0:
+        assert np.array_equal(np.asarray(o0[k])[e0h],
+                              np.asarray(o1[k])[e1h]), k
+
+
+@pytest.mark.parametrize("n,cbytes,want_chunks", [
+    (4096, 1 << 26, 1),    # chunk >= payload: single-shot
+    (4096, 4096, 2),       # two-chunk pipeline
+    (16384, 4096, 8),      # deep pipeline
+])
+def test_chunked_bit_identical_across_chunk_counts(dist_ctx, monkeypatch,
+                                                   n, cbytes,
+                                                   want_chunks):
+    """Every chunk count reproduces the single-shot result bit for
+    bit: same live rows, emit mask, counts_in and capacity."""
+    payload, targets, emit = _mk_exchange_inputs(dist_ctx, n)
+    counts = _counts(dist_ctx, targets, emit)
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "0")
+    base = _run(dist_ctx, payload, targets, emit, counts)
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "1")
+    monkeypatch.setenv("CYLON_EXCHANGE_CHUNK_BYTES", str(cbytes))
+    c0 = telemetry.metrics_snapshot().get(
+        "cylon_exchange_chunks_total", 0)
+    out = _run(dist_ctx, payload, targets, emit, counts)
+    _assert_bit_identical(base, out)
+    assert out[3].get("chunks", 1) == want_chunks
+    moved = telemetry.metrics_snapshot().get(
+        "cylon_exchange_chunks_total", 0) - c0
+    assert moved == (want_chunks if want_chunks > 1 else 0)
+
+
+def test_chunked_bit_identical_odd_remainder(dist_ctx, monkeypatch):
+    """A non-pow2 chunk block (forced plan) exercises the dropping-
+    scatter remainder path; the last partial chunk must neither wrap
+    nor clobber earlier rows."""
+    payload, targets, emit = _mk_exchange_inputs(dist_ctx, 4096, seed=3)
+    counts = _counts(dist_ctx, targets, emit)
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "0")
+    base = _run(dist_ctx, payload, targets, emit, counts)
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "1")
+    monkeypatch.setattr(
+        _shuffle, "_chunk_plan",
+        lambda block, w, rb: (3, -(-block // 3)) if block > 3
+        else (block, 1))
+    out = _run(dist_ctx, payload, targets, emit, counts)
+    _assert_bit_identical(base, out)
+    assert out[3]["chunks"] == -(-base[3]["block"] // 3)
+
+
+def test_chunked_world1_counted_route(monkeypatch):
+    """The counted padded route chunks even on a 1-wide mesh (the
+    1-chip bench shape): all_to_all is the identity, the pipeline
+    still bounds comm-buffer peaks."""
+    ctx = ct.CylonContext.InitDistributed(ct.TPUConfig(world_size=1))
+    payload, targets, emit = _mk_exchange_inputs(ctx, 2048, seed=5)
+    counts = _counts(ctx, targets, emit)
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "0")
+    base = _run(ctx, payload, targets, emit, counts)
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "1")
+    monkeypatch.setenv("CYLON_EXCHANGE_CHUNK_BYTES", "4096")
+    out = _run(ctx, payload, targets, emit, counts)
+    _assert_bit_identical(base, out)
+    assert out[3]["chunks"] > 1
+
+
+def test_chunked_skew_attrs_match_single_shot(dist_ctx, monkeypatch):
+    """Skew span attributes ride the ONE host count matrix, so a
+    chunked exchange reports exactly the single-shot combined matrix —
+    plus the chunk-pipeline attrs."""
+    payload, targets, emit = _mk_exchange_inputs(dist_ctx, 4096, seed=7)
+    counts = _counts(dist_ctx, targets, emit)
+    spans = []
+
+    def sink(span):
+        if span.name.startswith("shuffle.exchange"):
+            spans.append(dict(span.attrs))
+
+    telemetry.add_sink(sink)
+    try:
+        monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "0")
+        _run(dist_ctx, payload, targets, emit, counts)
+        monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "1")
+        monkeypatch.setenv("CYLON_EXCHANGE_CHUNK_BYTES", "4096")
+        _run(dist_ctx, payload, targets, emit, counts)
+    finally:
+        telemetry.remove_sink(sink)
+    assert len(spans) == 2
+    single, chunked = spans
+    skew_keys = [k for k in single
+                 if k.startswith(("skew_", "shard_"))]
+    assert skew_keys, single
+    for k in skew_keys:
+        assert single[k] == chunked[k], k
+    assert chunked["chunks"] > 1
+    assert chunked["chunk_block"] > 0
+    assert 0.0 < chunked["overlap_ratio"] < 1.0
+    assert "chunks" not in single
+
+
+def test_chunked_per_chunk_retry_bit_identical(dist_ctx, monkeypatch):
+    """A transient fault on a mid-stream chunk dispatch retries that
+    chunk idempotently; the recovered result is bit-identical."""
+    monkeypatch.setenv("CYLON_RETRY_BACKOFF_S", "0.001")
+    payload, targets, emit = _mk_exchange_inputs(dist_ctx, 4096, seed=9)
+    counts = _counts(dist_ctx, targets, emit)
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "0")
+    base = _run(dist_ctx, payload, targets, emit, counts)
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "1")
+    monkeypatch.setenv("CYLON_EXCHANGE_CHUNK_BYTES", "4096")
+
+    def retries():
+        return sum(v for k, v in telemetry.metrics_snapshot().items()
+                   if k.startswith("cylon_retries_total"))
+
+    r0 = retries()
+    _inject.arm("exchange:2:transient")
+    try:
+        out = _run(dist_ctx, payload, targets, emit, counts)
+    finally:
+        _inject.disarm()
+    assert retries() > r0
+    assert out[3]["chunks"] > 1
+    _assert_bit_identical(base, out)
+
+
+def test_fused_partition_launches_strictly_fewer(dist_ctx, monkeypatch):
+    """The fused partition+chunk-0 program: a C-chunk exchange costs C
+    collective launches; the unfused form costs C+1."""
+    payload, targets, emit = _mk_exchange_inputs(dist_ctx, 4096, seed=11)
+    counts = _counts(dist_ctx, targets, emit)
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "1")
+    monkeypatch.setenv("CYLON_EXCHANGE_CHUNK_BYTES", "4096")
+
+    def launches():
+        return telemetry.metrics_snapshot().get(
+            "cylon_collective_launches_total", 0)
+
+    l0 = launches()
+    fused = _run(dist_ctx, payload, targets, emit, counts, fuse=True)
+    l1 = launches()
+    unfused = _run(dist_ctx, payload, targets, emit, counts, fuse=False)
+    l2 = launches()
+    chunks = fused[3]["chunks"]
+    assert chunks > 1
+    assert l1 - l0 == chunks          # fused: C programs
+    assert l2 - l1 == chunks + 1      # unfused: partition + C
+    _assert_bit_identical(fused, unfused)
+
+
+def test_exchange_pair_routes_through_chunked(dist_ctx, monkeypatch):
+    """When a side is big enough to chunk, exchange_pair falls through
+    to two chunked exchanges; results match the monolithic pair
+    program bit for bit."""
+    import jax.numpy as jnp
+
+    world = dist_ctx.get_world_size()
+    rng = np.random.default_rng(13)
+    n1, n2 = 4096, 2048
+
+    def side(n, seed):
+        r = np.random.default_rng(seed)
+        p = {"a": _shard.pin(jnp.asarray(
+                 r.integers(0, 1 << 30, n).astype(np.int32)), dist_ctx),
+             "b": _shard.pin(jnp.asarray(
+                 r.normal(size=n).astype(np.float32)), dist_ctx)}
+        t = _shard.pin(jnp.asarray(
+            r.integers(0, world, n).astype(np.int32)), dist_ctx)
+        e = _shard.pin(jnp.asarray(r.random(n) < 0.9), dist_ctx)
+        return p, t, e
+
+    p1, t1, e1 = side(n1, 13)
+    p2, t2, e2 = side(n2, 14)
+    c1, c2 = _shuffle.count_pair(t1, e1, t2, e2, dist_ctx)
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "0")
+    b1, b2 = _shuffle.exchange_pair(p1, t1, e1, c1, p2, t2, e2, c2,
+                                    dist_ctx)
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", "1")
+    monkeypatch.setenv("CYLON_EXCHANGE_CHUNK_BYTES", "4096")
+    o1, o2 = _shuffle.exchange_pair(p1, t1, e1, c1, p2, t2, e2, c2,
+                                    dist_ctx)
+    _assert_bit_identical(b1, o1)
+    _assert_bit_identical(b2, o2)
+    assert o1[3].get("chunks", 1) > 1 or o2[3].get("chunks", 1) > 1
+
+
+@pytest.mark.parametrize("overlap", ["0", "1"])
+def test_distributed_join_identical_under_overlap(dist_ctx, monkeypatch,
+                                                  overlap):
+    """End to end through the dist_ops composition: the distributed
+    join's rows are independent of the overlap knob."""
+    monkeypatch.setenv("CYLON_EXCHANGE_OVERLAP", overlap)
+    monkeypatch.setenv("CYLON_EXCHANGE_CHUNK_BYTES", "4096")
+    rng = np.random.default_rng(17)
+    n = 4096
+    left = ct.Table.from_pydict(dist_ctx, {
+        "k": rng.integers(0, n // 4, n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32)})
+    right = ct.Table.from_pydict(dist_ctx, {
+        "k": rng.integers(0, n // 4, n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32)})
+    got = left.distributed_join(right, "inner", on="k").to_pandas()
+    lctx = ct.CylonContext.Init()
+    want = ct.Table.from_pydict(lctx, {
+        "k": np.asarray(left.to_pydict()["k"]),
+        "v": np.asarray(left.to_pydict()["v"])}).join(
+        ct.Table.from_pydict(lctx, {
+            "k": np.asarray(right.to_pydict()["k"]),
+            "w": np.asarray(right.to_pydict()["w"])}),
+        "inner", on="k").to_pandas()
+
+    def canon(df):
+        df = df.copy()
+        df.columns = range(df.shape[1])
+        return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+    import pandas as pd
+
+    pd.testing.assert_frame_equal(canon(got), canon(want),
+                                  check_dtype=False, atol=1e-6)
