@@ -300,6 +300,19 @@ def bench_shuffle_pipeline(ctx, n_rows: int, iters: int) -> dict:
         one(fuse=False)
         nofuse_launches = launches() - l0
         chunked_s = _time(one, iters)
+        # partition wall in isolation, on the ROUTED path (pallas on
+        # TPU, sort elsewhere) — the number the fused Pallas kernel
+        # exists to shrink; benchtrend gates it LOWER_IS_BETTER
+        part = _shuffle._partition_path(ctx.mesh, world, payload)
+        cb_p, _ = _shuffle._chunk_plan(block, world, bytes_per_row)
+        pfn = _shuffle._exchange_partition_fn(ctx.mesh, block, cb_p,
+                                              part)
+
+        def partition_only():
+            jax.device_get(jax.tree.leaves(
+                pfn(payload, targets, emit)[0])[0][:1])
+
+        partition_s = _time(partition_only, iters)
         os.environ["CYLON_EXCHANGE_OVERLAP"] = "0"
         single_s = _time(one, iters)
     finally:
@@ -314,6 +327,8 @@ def bench_shuffle_pipeline(ctx, n_rows: int, iters: int) -> dict:
     gbps = n_rows * bytes_per_row / chunked_s / 1e9 / world
     return {
         "exchange_wall_s": _sig(chunked_s),
+        "partition_wall_s": _sig(partition_s),
+        "partition_path": _shuffle.partition_path_label(part),
         "single_shot_wall_s": _sig(single_s),
         "speedup_vs_single_shot": _sig(single_s / chunked_s, 4)
         if chunked_s else 0.0,

@@ -222,12 +222,21 @@ def default_entry_points() -> List[EntryPoint]:
             lambda m: rows(i32, b, i32, b), factory="_count2_fn"),
         EntryPoint(
             "exchange_padded", sh,
-            lambda m: S(m)._exchange_padded_fn(m, 16),
+            lambda m: S(m)._exchange_padded_fn(m, 16, "sort"),
+            lambda m: (payload(),) + rows(i32, b),
+            factory="_exchange_padded_fn"),
+        EntryPoint(
+            # the fused Pallas partition path, traced through the
+            # interpreter so the axis/all-to-all/f64 checks cover the
+            # kernel-routed program off-TPU too
+            "exchange_padded_kernel", sh,
+            lambda m: S(m)._exchange_padded_fn(m, 16, "interp"),
             lambda m: (payload(),) + rows(i32, b),
             factory="_exchange_padded_fn"),
         EntryPoint(
             "exchange_padded_pair", sh,
-            lambda m: S(m)._exchange_padded_pair_fn(m, 16, 16),
+            lambda m: S(m)._exchange_padded_pair_fn(m, 16, 16,
+                                                    "sort", "sort"),
             lambda m: (payload(),) + rows(i32, b)
             + (payload(),) + rows(i32, b),
             factory="_exchange_padded_pair_fn"),
@@ -238,12 +247,18 @@ def default_entry_points() -> List[EntryPoint]:
             factory="_exchange_fn"),
         EntryPoint(
             "exchange_partition", sh,
-            lambda m: S(m)._exchange_partition_fn(m, 16, 8),
+            lambda m: S(m)._exchange_partition_fn(m, 16, 8, "sort"),
             lambda m: (payload(),) + rows(i32, b),
             factory="_exchange_partition_fn"),
         EntryPoint(
             "exchange_chunk_first", sh,
-            lambda m: S(m)._exchange_chunk_first_fn(m, 16, 8),
+            lambda m: S(m)._exchange_chunk_first_fn(m, 16, 8, "sort"),
+            lambda m: (payload(),) + rows(i32, b),
+            factory="_exchange_chunk_first_fn"),
+        EntryPoint(
+            # chunked pipeline head with the Pallas partition folded in
+            "exchange_chunk_first_kernel", sh,
+            lambda m: S(m)._exchange_chunk_first_fn(m, 16, 8, "interp"),
             lambda m: (payload(),) + rows(i32, b),
             factory="_exchange_chunk_first_fn"),
         EntryPoint(

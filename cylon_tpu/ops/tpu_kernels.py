@@ -38,6 +38,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -91,19 +92,33 @@ def pad_rows(x: jnp.ndarray, rows: int, fill=0) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+# np scalar, not a bare python int: weak literals in kernel jaxprs are
+# re-canonicalized (i64 under jax_enable_x64) when the interpret
+# lowering discharges inside an enclosing jit — see block_cumsum
+_L32 = np.int32(LANES)
+
+
 def flat_iota(shape) -> jnp.ndarray:
-    return (jax.lax.broadcasted_iota(jnp.int32, shape, 0) * LANES
+    return (jax.lax.broadcasted_iota(jnp.int32, shape, 0) * _L32
             + jax.lax.broadcasted_iota(jnp.int32, shape, 1))
 
 
 def block_cumsum(x: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
-    """Inclusive scan of a (R,128) int32 block in flat row-major order."""
+    """Inclusive scan of a (R,128) int32 block in flat row-major order.
+
+    Scalar where-branches carry STRONG dtypes (``x.dtype.type(0)``, not
+    a bare ``0``): a weak python literal in the kernel jaxpr is
+    re-canonicalized when the interpret lowering discharges inside an
+    enclosing jit — under jax_enable_x64 it comes back i64 and fails
+    select_n's strict dtype check. Same rule for every kernel helper
+    below."""
     R = x.shape[0]
+    zero = x.dtype.type(0)
     lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     v = x
     k = 1
     while k < LANES:
-        v = v + jnp.where(lane >= k, _roll(v, k, 1, interpret), 0)
+        v = v + jnp.where(lane >= k, _roll(v, k, 1, interpret), zero)
         k <<= 1
     if R == 1:
         return v
@@ -112,7 +127,8 @@ def block_cumsum(x: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
     inc = tot
     k = 1
     while k < R:
-        inc = inc + jnp.where(riota >= k, _roll(inc, k, 0, interpret), 0)
+        inc = inc + jnp.where(riota >= k, _roll(inc, k, 0, interpret),
+                              zero)
         k <<= 1
     return v + (inc - tot)
 
@@ -122,7 +138,7 @@ def block_cummax(x: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
     order (same log-shift structure as block_cumsum)."""
     R = x.shape[0]
     lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-    neg = jnp.iinfo(x.dtype).min
+    neg = x.dtype.type(jnp.iinfo(x.dtype).min)  # strong: see block_cumsum
     v = x
     k = 1
     while k < LANES:
@@ -153,13 +169,14 @@ def flat_shift(x: jnp.ndarray, s, fill=0, interpret: bool = False
     rb = _roll(ra, 1, 0, interpret)  # rows down by one
     shifted = jnp.where(lane >= s, ra, rb)
     fi = flat_iota(x.shape)
-    return jnp.where(fi >= s, shifted, fill)
+    return jnp.where(fi >= s, shifted,
+                     jnp.asarray(fill, x.dtype))  # strong: block_cumsum
 
 
 def _dyn_roll_lanes(x, s):
     """Roll lanes by dynamic s using take_along_axis (Mosaic-native)."""
     lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-    src = (lane - s) % LANES
+    src = (lane - s) % _L32
     return jnp.take_along_axis(x, src, axis=1)
 
 
@@ -178,9 +195,10 @@ def flat_shift_up(x: jnp.ndarray, k: int, fill=0, interpret: bool = False
         b = _roll(x, (R - rows_k - 1) % R, 0, interpret)
         ra = _roll(a, LANES - q, 1, interpret)
         rb = _roll(b, LANES - q, 1, interpret)
-        shifted = jnp.where(lane < LANES - q, ra, rb)
+        shifted = jnp.where(lane < np.int32(LANES - q), ra, rb)
     fi = flat_iota(x.shape)
-    return jnp.where(fi < span - k, shifted, fill)
+    return jnp.where(fi < np.int32(span - k), shifted,
+                     jnp.asarray(fill, x.dtype))  # strong: block_cumsum
 
 
 def sweep_gather(win: jnp.ndarray, o: jnp.ndarray, fill=0) -> jnp.ndarray:
@@ -859,19 +877,23 @@ def _compact_write(BR, m, vals, out_refs, wptr, wslot, tails, trow0,
     P = block_cumsum(m, interpret)
     cnt = P[BR - 1, LANES - 1]
     base = wptr[wslot]
-    s = base % LANES
+    s = base % _L32
 
+    one_u = np.uint32(1)
     q = flat_iota((BR, LANES))
-    d = q + 1 - P          # unselected before j (exclusive, j selected)
-    pack = ((d.astype(jnp.uint32) << 1) | m.astype(jnp.uint32))
+    d = q + np.int32(1) - P  # unselected before j (exclusive, j selected)
+    pack = ((d.astype(jnp.uint32) << one_u) | m.astype(jnp.uint32))
     vals = list(vals)
     span = BR * LANES
     k = 1
     b = 0
     while k < span:
         pa = flat_shift_up(pack, k, 0, interpret)
-        take = ((pa & 1) == 1) & (((pa >> 1) >> b) & 1 == 1)
-        keep = ((pack & 1) == 1) & (((pack >> 1) >> b) & 1 == 0)
+        bshift = np.uint32(b)
+        take = ((pa & one_u) == one_u) \
+            & (((pa >> one_u) >> bshift) & one_u == one_u)
+        keep = ((pack & one_u) == one_u) \
+            & (((pack >> one_u) >> bshift) & one_u == np.uint32(0))
         pack = jnp.where(take, pa, jnp.where(keep, pack, jnp.uint32(0)))
         vals = [jnp.where(take, flat_shift_up(v, k, 0, interpret),
                           jnp.where(keep, v, jnp.uint32(0)))
@@ -890,13 +912,13 @@ def _compact_write(BR, m, vals, out_refs, wptr, wslot, tails, trow0,
         blk = jnp.concatenate([first, shifted[1:]])
         bufs[k][:] = blk
         pltpu.make_async_copy(
-            bufs[k], out_refs[k].at[pl.ds(base // LANES, BR + 8)],
+            bufs[k], out_refs[k].at[pl.ds(base // _L32, BR + 8)],
             sems.at[srow0 + k]).start()
     newp = base + cnt
-    rel = newp // LANES - base // LANES
+    rel = newp // _L32 - base // _L32
     for k in range(nstreams):
         pltpu.make_async_copy(
-            bufs[k], out_refs[k].at[pl.ds(base // LANES, BR + 8)],
+            bufs[k], out_refs[k].at[pl.ds(base // _L32, BR + 8)],
             sems.at[srow0 + k]).wait()
         tails[trow0 + k:trow0 + k + 1, :] = bufs[k][pl.ds(rel, 1), :]
     wptr[wslot] = newp
@@ -949,6 +971,171 @@ def _compact_streams(nstreams, BR, mask_ref, streams, out_refs, cnt_ref,
             return _
 
         jax.lax.fori_loop(0, nwin, zero_one, 0)
+
+
+# ---------------------------------------------------------------------------
+# partition_hist / partition_scatter — the fused shuffle partitioner
+# ---------------------------------------------------------------------------
+# The counted padded exchange (parallel/shuffle._padded_partition) needs a
+# STABLE partition of every payload leaf into <= W+1 contiguous buckets
+# (W live targets + the dead-row tail). The XLA route is a full stable
+# multi-operand `jax.lax.sort` by target — a comparison network priced
+# O(n log n) (96-192 ms for a 33M-row multi-operand sort on v5e) where
+# the problem only needs a counting sort. These two kernels replace it
+# with the SURVEY §7 shape: one histogram pass and one scatter pass,
+# both bandwidth-bound sequential HBM streams.
+#
+# * ``partition_hist``   — pass 1: streams the target-id blocks once and
+#   emits the per-block × per-bucket histogram. Summed over blocks it is
+#   the counts vector (replacing W compare-sum passes of
+#   shuffle._target_counts); exclusively scanned it is the bucket start
+#   offsets. Zero extra passes over payload.
+# * ``partition_scatter`` — pass 2: a (nbuckets, blocks) grid, bucket-
+#   major. TPU grid order is sequential, so appending each block's
+#   bucket-w rows (staged-shift compaction, `_compact_write`'s
+#   partial-row-tail discipline, ONE global write pointer) IS a stable
+#   counting sort: bucket 0's rows land first in block order, then
+#   bucket 1's, … — bit-for-bit the permutation `jax.lax.sort(…,
+#   is_stable=True)` by target produces. Every payload leaf rides the
+#   same pass as a u32 leg, so one kernel materializes the whole
+#   partition (varbytes word legs included).
+#
+# Traffic: pass 2 re-streams the input once per bucket (blocked
+# prefetch), so the pair costs ~(W+2) elementwise-priced passes — a win
+# over the sort up to W≈16 (shuffle routes by world size; empty-bucket
+# appends skip their DMA entirely, so clustered/skewed inputs pay less).
+# ---------------------------------------------------------------------------
+
+
+def partition_hist(t_s: jnp.ndarray, nbuckets: int, block_rows: int = 32,
+                   interpret: bool = False) -> jnp.ndarray:
+    """Per-block bucket histogram of a target-id stream.
+
+    t_s: (n,) int32 bucket ids in [0, nbuckets); out-of-range ids are
+    never counted (padding uses id nbuckets). Returns (blocks,
+    nbuckets) int32 with blocks = ceil(n / (block_rows*128)):
+    ``out[b, w]`` = #rows of block b with id w. ``out.sum(0)`` is the
+    counts vector; an exclusive scan of it the bucket starts.
+    Requires nbuckets <= 128 (one lane row carries a block's histogram).
+    """
+    n = t_s.shape[0]
+    BR = block_rows
+    assert BR % 8 == 0 and BR >= 8
+    assert 1 <= nbuckets <= LANES
+    blocks = max(-(-n // (BR * LANES)), 1)
+    rows = blocks * BR
+    t2 = pad_rows(t_s.astype(jnp.int32), rows, fill=nbuckets)
+
+    def kernel(t_ref, hist_ref):
+        tv = t_ref[:]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+        row = jnp.zeros((1, LANES), jnp.int32)
+        for w in range(nbuckets):
+            c = jnp.sum((tv == w).astype(jnp.int32))
+            row = jnp.where(lane == w, c, row)
+        hist_ref[:] = row
+
+    res = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((blocks, LANES), jnp.int32),
+        grid=(blocks,),
+        in_specs=[pl.BlockSpec((BR, LANES), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, LANES), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+    with _x32_trace():
+        res = res(t2)
+    return res[:, :nbuckets]
+
+
+def partition_scatter(t_s: jnp.ndarray, streams: Sequence[jnp.ndarray],
+                      nbuckets: int, block_rows: int = 32,
+                      interpret: bool = False
+                      ) -> Tuple[jnp.ndarray, ...]:
+    """Stable counting scatter of u32 streams into bucket-contiguous
+    layout — the partition permutation applied to every leg at once.
+
+    t_s: (n,) int32 bucket ids in [0, nbuckets); streams: (n,) u32 legs
+    (callers bitcast/split wider dtypes). Returns one (n,) u32 array
+    per leg holding ``leg[perm]`` where perm is the stable sort by
+    bucket id — identical to ``jax.lax.sort((t,)+legs, num_keys=1,
+    is_stable=True)`` including rows of the last (dead) bucket.
+
+    Grid is (nbuckets, blocks), bucket-major; grid order on TPU is
+    sequential, so the single carried write pointer makes the appends a
+    stable counting sort. A (bucket, block) pair with no matching rows
+    skips its compaction and DMA entirely.
+    """
+    n = t_s.shape[0]
+    BR = block_rows
+    L = len(streams)
+    assert BR % 8 == 0 and BR >= 8
+    assert 1 <= nbuckets <= LANES
+    assert L >= 1
+    for s in streams:
+        assert s.dtype == jnp.uint32, \
+            f"partition_scatter takes u32 legs, got {s.dtype}"
+        assert s.shape == (n,)
+    blocks = max(-(-n // (BR * LANES)), 1)
+    rows = blocks * BR
+    # pad id nbuckets: matches NO grid bucket, so padding is never
+    # scattered and the write pointer ends exactly at n
+    t2 = pad_rows(t_s.astype(jnp.int32), rows, fill=nbuckets)
+    s2 = [pad_rows(s, rows) for s in streams]
+
+    out_rows = rows + BR + 8  # append windows may extend past rows
+
+    scratch = ([pltpu.SMEM((1,), jnp.int32),
+                pltpu.VMEM((L, LANES), jnp.uint32)]
+               + [pltpu.VMEM((BR + 8, LANES), jnp.uint32)
+                  for _ in range(L)]
+               + [pltpu.SemaphoreType.DMA((L,))])
+
+    out_shapes = [jax.ShapeDtypeStruct((out_rows, LANES), jnp.uint32)
+                  for _ in range(L)]
+
+    def kernel(t_ref, *rest):
+        srefs = rest[:L]
+        outs = list(rest[L:2 * L])
+        wptr = rest[2 * L]
+        tails = rest[2 * L + 1]
+        bufs = list(rest[2 * L + 2:2 * L + 2 + L])
+        sems = rest[2 * L + 2 + L]
+        w = pl.program_id(0)
+        b = pl.program_id(1)
+
+        @pl.when((w == 0) & (b == 0))
+        def _():
+            # jnp.int32, not a bare 0: a weak python literal survives
+            # into the kernel jaxpr and is re-canonicalized to int64
+            # when the interpret lowering runs under jax_enable_x64 —
+            # the store then fails the dynamic_update_slice dtype check
+            wptr[0] = jnp.int32(0)
+            tails[:] = jnp.zeros((L, LANES), jnp.uint32)
+
+        m = (t_ref[:] == w).astype(jnp.int32)
+
+        @pl.when(jnp.sum(m) > 0)
+        def _():
+            _compact_write(BR, m, [r[:] for r in srefs], outs, wptr, 0,
+                           tails, 0, bufs, sems, 0, interpret)
+
+    res = pl.pallas_call(
+        kernel,
+        out_shape=out_shapes,
+        grid=(nbuckets, blocks),
+        in_specs=[pl.BlockSpec((BR, LANES), lambda w, b: (b, 0),
+                               memory_space=pltpu.VMEM)] * (1 + L),
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * L,
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )
+    with _x32_trace():
+        res = res(t2, *s2)
+    return tuple(o.reshape(-1)[:n] for o in res)
 
 
 # ---------------------------------------------------------------------------

@@ -47,6 +47,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from ..context import CylonContext
+from ..ops import tpu_kernels as _tpuk
 from ..resilience import inject as _inject
 from ..resilience import retry as _retry
 from ..telemetry import REGISTRY as _REGISTRY
@@ -77,6 +78,24 @@ MAX_CHUNKS = 64
 # programs issued while earlier chunk work was still in flight
 # ((programs-1)/programs) — 0.0 is single-shot, ->1.0 is a deep pipeline
 OVERLAP_BUCKETS = (0.0, 0.25, 0.5, 0.75, 0.875, 0.9375, 1.0)
+
+
+def _shard_map_for(part, kernel, mesh, in_specs, out_specs):
+    """jitted shard_map builder for the padded exchange programs: the
+    sort path keeps the varying-mesh-axes replication check (the exact
+    pre-kernel program); the Pallas partition path disables it —
+    shard_map has no replication rule for pallas_call, and the kernel
+    is purely per-shard (no collectives inside)."""
+    if part == "sort":
+        return jax.jit(shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))
+    try:
+        sm = shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=False)
+    except TypeError:  # pragma: no cover - jax>=0.8 spelling
+        sm = shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    return jax.jit(sm)
 
 
 def replicated_gather(x, axis: str, world: int):
@@ -269,15 +288,151 @@ def _padded_body_w1(axis, block, payload, targets, emit):
     return jax.tree.unflatten(treedef, list(outs)), new_emit, counts_in
 
 
-def _padded_partition(axis, world, block, payload, targets, emit):
+# ---------------------------------------------------------------------------
+# the fused partition kernel (ROADMAP item 2 close-out, SURVEY §7): the
+# padded-mode partition — a stable bucket sort by target — is the one
+# spot the survey reserves Pallas for. CYLON_PARTITION_KERNEL routes it:
+# "auto" picks the two-pass histogram+scatter kernel on TPU (up to
+# _PARTITION_MAX_WORLD targets — past that the scatter's per-bucket
+# passes cost more than the sort), "sort" forces the XLA stable sort
+# everywhere (the exact pre-kernel program — the path string is part of
+# every factory cache key), "pallas" forces the kernel (interpreter
+# off-TPU; tests pin bit-identity through it). Both paths return the
+# identical (sorted_leaves, counts_out, start) triple, so everything
+# downstream — chunk pipeline, skew attrs, ledger, admission — is
+# partition-path-oblivious.
+# ---------------------------------------------------------------------------
+
+# beyond this world size the scatter pass's per-bucket input streaming
+# (~world+2 elementwise-priced passes) loses to the one stable sort
+_PARTITION_MAX_WORLD = 16
+
+
+def _partition_eligible(payload) -> bool:
+    """Every leaf must split into u32 legs: 1-D/2-D, 1/2/4/8-byte."""
+    return all(
+        x.ndim in (1, 2) and np.dtype(x.dtype).itemsize in (1, 2, 4, 8)
+        for x in jax.tree.leaves(payload))
+
+
+def _partition_path(mesh, world: int, payload) -> str:
+    """Resolve the partition path for one exchange dispatch — "sort",
+    "pallas" (compiled kernel) or "interp" (interpreter, tests). The
+    result keys the exchange factory caches, so flipping the knob can
+    never reuse a program built for the other path."""
+    mode = _knobs.get("CYLON_PARTITION_KERNEL")
+    if mode not in ("auto", "pallas", "sort"):
+        mode = "auto"
+    # world+1 buckets (dead rows included) must fit one histogram lane
+    # row — past that even a forced knob falls back to the sort
+    if mode == "sort" or world < 2 or world + 1 > _tpuk.LANES \
+            or not _partition_eligible(payload):
+        return "sort"
+    on_tpu = mesh.devices.flat[0].platform == "tpu"
+    if mode == "pallas":
+        return "pallas" if on_tpu else "interp"
+    return "pallas" if on_tpu and world <= _PARTITION_MAX_WORLD \
+        else "sort"
+
+
+def partition_path_label(part: str) -> str:
+    """The PUBLIC spelling of a partition path: "interp" is the
+    interpreter form of the kernel — one label, ``pallas``."""
+    return "sort" if part == "sort" else "pallas"
+
+
+def _record_partition(sp, *parts: str) -> None:
+    """Observability for the partition-path decisions of one dispatch
+    (one per exchange, two for a fused pair): the
+    cylon_partition_path_total counter per side, and ONE
+    partition_path span attr EXPLAIN ANALYZE folds per node ("mixed"
+    when a pair's sides differ)."""
+    paths = [partition_path_label(p) for p in parts]
+    sp.set(partition_path=paths[0] if len(set(paths)) == 1 else "mixed")
+    for p in paths:
+        _counter("cylon_partition_path_total", {"path": p}).inc()
+
+
+def _leg_split(x):
+    """One payload leaf → (u32 (n,) legs, join(legs) -> leaf).
+
+    The partition kernel moves 32-bit lanes; wider dtypes ride as
+    word legs (the varbytes trick applied to every column), narrower
+    ones widen value-exactly, 2-D leaves split per column. Round trips
+    are bit-exact: bitcasts for 4/8-byte, value casts for 1/2-byte
+    (lossless by range)."""
+    if x.ndim == 2:
+        subs = [_leg_split(x[:, j]) for j in range(x.shape[1])]
+        legs = [leg for sub_legs, _ in subs for leg in sub_legs]
+
+        def join2d(ls, subs=subs):
+            outs, i = [], 0
+            for sub_legs, sub_join in subs:
+                outs.append(sub_join(ls[i:i + len(sub_legs)]))
+                i += len(sub_legs)
+            return jnp.stack(outs, axis=1)
+
+        return legs, join2d
+    dt = x.dtype
+    size = np.dtype(dt).itemsize
+    if size == 4:
+        if dt == jnp.uint32:
+            return [x], lambda ls: ls[0]
+        return ([jax.lax.bitcast_convert_type(x, jnp.uint32)],
+                lambda ls: jax.lax.bitcast_convert_type(ls[0], dt))
+    if size == 8:
+        pair = jax.lax.bitcast_convert_type(x, jnp.uint32)  # (n, 2)
+        return ([pair[:, 0], pair[:, 1]],
+                lambda ls: jax.lax.bitcast_convert_type(
+                    jnp.stack(ls, axis=1), dt))
+    if dt == jnp.bool_:
+        return ([x.astype(jnp.uint32)],
+                lambda ls: ls[0].astype(jnp.bool_))
+    narrow = jnp.uint16 if size == 2 else jnp.uint8
+    return ([jax.lax.bitcast_convert_type(x, narrow).astype(jnp.uint32)],
+            lambda ls: jax.lax.bitcast_convert_type(
+                ls[0].astype(narrow), dt))
+
+
+def _kernel_partition(payload, targets, emit, world, interpret: bool):
+    """The Pallas twin of `_bucket_sort`: identical contract — stable
+    by target, dead rows (emit False) keyed ``world`` to the tail,
+    (sorted leaves, counts_out, start) — via one histogram pass and one
+    counting-scatter pass instead of an O(n log n) multi-operand sort.
+    Bit-for-bit the same permutation: the scatter's sequential
+    bucket-major appends ARE the stable sort order."""
+    t = jnp.where(emit, targets.astype(jnp.int32), world)
+    leaves, treedef = jax.tree.flatten(payload)
+    splits = [_leg_split(x) for x in leaves]
+    flat_legs = [leg for legs, _ in splits for leg in legs]
+    hist = _tpuk.partition_hist(t, world + 1, interpret=interpret)
+    counts_out = hist[:, :world].sum(axis=0, dtype=jnp.int32)
+    start = jnp.cumsum(counts_out) - counts_out
+    outs = _tpuk.partition_scatter(t, flat_legs, world + 1,
+                                   interpret=interpret)
+    out_leaves, i = [], 0
+    for legs, join in splits:
+        out_leaves.append(join(list(outs[i:i + len(legs)])))
+        i += len(legs)
+    return jax.tree.unflatten(treedef, out_leaves), counts_out, start
+
+
+def _padded_partition(axis, world, block, payload, targets, emit,
+                      part: str = "sort"):
     """The shared partition prefix of BOTH padded-mode bodies (the
-    single-shot program and the chunked pipeline): bucket sort, device
-    counts exchange, per-target start offsets and the final emit mask.
-    ONE copy on purpose — the chunked path's bit-identity with the
-    single-shot program is structural, not two texts kept in sync."""
+    single-shot program and the chunked pipeline): stable partition by
+    target (`part` picks the XLA bucket sort or the fused Pallas
+    kernel — bit-identical layouts), device counts exchange, per-target
+    start offsets and the final emit mask. ONE copy on purpose — the
+    chunked path's bit-identity with the single-shot program is
+    structural, not two texts kept in sync."""
     cap_out = world * block
-    sorted_leaves, counts_out, start = _bucket_sort(
-        payload, targets, emit, world)
+    if part == "sort":
+        sorted_leaves, counts_out, start = _bucket_sort(
+            payload, targets, emit, world)
+    else:
+        sorted_leaves, counts_out, start = _kernel_partition(
+            payload, targets, emit, world, interpret=part == "interp")
     counts_in = jax.lax.all_to_all(counts_out, axis, split_axis=0,
                                    concat_axis=0, tiled=True)
     pos = jnp.arange(cap_out, dtype=jnp.int32)
@@ -285,14 +440,17 @@ def _padded_partition(axis, world, block, payload, targets, emit):
     return sorted_leaves, counts_in, start, new_emit
 
 
-def _padded_body(axis, world, block, payload, targets, emit):
+def _padded_body(axis, world, block, payload, targets, emit,
+                 part: str = "sort"):
     """The padded-mode exchange as a pure function of per-shard values —
-    shared by the single and the PAIR program builders."""
+    shared by the single and the PAIR program builders. ``part`` picks
+    the partition path (world-1 keeps the cond-gated sort: a 1-bucket
+    counting sort buys nothing over the identity fast path)."""
     if world == 1:
         return _padded_body_w1(axis, block, payload, targets, emit)
     cap_out = world * block
     sorted_leaves, counts_in, start, new_emit = _padded_partition(
-        axis, world, block, payload, targets, emit)
+        axis, world, block, payload, targets, emit, part)
 
     def one(xs):
         pad = jnp.zeros((block,) + xs.shape[1:], xs.dtype)
@@ -307,21 +465,24 @@ def _padded_body(axis, world, block, payload, targets, emit):
 
 
 @counted_cache
-def _exchange_padded_fn(mesh, block: int):
+def _exchange_padded_fn(mesh, block: int, part: str = "sort"):
     """Scatter-free single-shot exchange: every (src,dst) pair moves ONE
     [block] slice and lands at the STATIC slot dst_out[src*block:...] —
     no receive scatter at all. Output is PADDED per source (emit mask
     marks each source's live prefix), capacity world*block; the host
-    routes here when that padding is acceptable (see exchange())."""
+    routes here when that padding is acceptable (see exchange()).
+    ``part`` (the partition path — see _partition_path) is part of the
+    cache key: a knob flip can never reuse the other path's program."""
     axis = mesh.axis_names[0]
     world = mesh.devices.size
     spec = P(axis)
 
     def kernel(payload, targets, emit):
-        return _padded_body(axis, world, block, payload, targets, emit)
+        return _padded_body(axis, world, block, payload, targets, emit,
+                            part)
 
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec))
+    return _shard_map_for(part, kernel, mesh, (spec, spec, spec),
+                          spec)
 
 
 # ---------------------------------------------------------------------------
@@ -382,15 +543,16 @@ def _chunk_write(axis, world, block, cb, xs, start, out, o):
 
 
 def _partition_body(axis, world, block, cb, payload, targets, emit,
-                    first_chunk: bool):
+                    first_chunk: bool, part: str = "sort"):
     """The partition phase of the chunked exchange as a pure per-shard
-    function: bucket sort, device counts exchange, chunk-padded sorted
-    leaves, zeroed output accumulators and the final emit mask —
-    everything the per-chunk programs consume. ``first_chunk`` folds
-    chunk 0's exchange+compaction in (the fused form)."""
+    function: stable partition (``part``-routed), device counts
+    exchange, chunk-padded sorted leaves, zeroed output accumulators
+    and the final emit mask — everything the per-chunk programs
+    consume. ``first_chunk`` folds chunk 0's exchange+compaction in
+    (the fused form)."""
     cap_out = world * block
     sorted_leaves, counts_in, start, new_emit = _padded_partition(
-        axis, world, block, payload, targets, emit)
+        axis, world, block, payload, targets, emit, part)
     padded = jax.tree.map(
         lambda x: jnp.concatenate(
             [x, jnp.zeros((cb,) + x.shape[1:], x.dtype)]),
@@ -408,7 +570,8 @@ def _partition_body(axis, world, block, cb, payload, targets, emit,
 
 
 @counted_cache
-def _exchange_partition_fn(mesh, block: int, chunk_block: int):
+def _exchange_partition_fn(mesh, block: int, chunk_block: int,
+                           part: str = "sort"):
     """UNFUSED partition program of the chunked exchange (no chunk 0):
     kept as a real dispatchable program so the profiler and the
     shuffle_pipeline bench can measure the fusion win of
@@ -420,14 +583,15 @@ def _exchange_partition_fn(mesh, block: int, chunk_block: int):
 
     def kernel(payload, targets, emit):
         return _partition_body(axis, world, block, chunk_block,
-                               payload, targets, emit, first_chunk=False)
+                               payload, targets, emit,
+                               first_chunk=False, part=part)
 
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 3,
-                             out_specs=spec))
+    return _shard_map_for(part, kernel, mesh, (spec,) * 3, spec)
 
 
 @counted_cache
-def _exchange_chunk_first_fn(mesh, block: int, chunk_block: int):
+def _exchange_chunk_first_fn(mesh, block: int, chunk_block: int,
+                             part: str = "sort"):
     """FUSED partition+exchange program — the single-table analog of
     the `_exchange_padded_pair_fn` trick (two stages in ONE compiled
     program, one dispatch where two would do): the partition body with
@@ -441,10 +605,10 @@ def _exchange_chunk_first_fn(mesh, block: int, chunk_block: int):
 
     def kernel(payload, targets, emit):
         return _partition_body(axis, world, block, chunk_block,
-                               payload, targets, emit, first_chunk=True)
+                               payload, targets, emit,
+                               first_chunk=True, part=part)
 
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 3,
-                             out_specs=spec))
+    return _shard_map_for(part, kernel, mesh, (spec,) * 3, spec)
 
 
 @counted_cache
@@ -477,7 +641,8 @@ def _exchange_chunk_fn(mesh, block: int, chunk_block: int):
 
 
 def _dispatch_chunked(ctx: CylonContext, block: int, cb: int,
-                      chunks: int, payload, targets, emit, fuse: bool):
+                      chunks: int, payload, targets, emit, fuse: bool,
+                      part: str = "sort"):
     """Launch the chunked pipeline: one partition program (with chunk 0
     folded in when ``fuse``), then one chunk program per remaining
     chunk — dispatched back to back WITHOUT waiting, so chunk N+1's
@@ -490,12 +655,12 @@ def _dispatch_chunked(ctx: CylonContext, block: int, cb: int,
     mesh = ctx.mesh
     if fuse:
         padded, start, counts_in, new_emit, outs = _launch_exchange(
-            lambda: _exchange_chunk_first_fn(mesh, block, cb)(
+            lambda: _exchange_chunk_first_fn(mesh, block, cb, part)(
                 payload, targets, emit))
         k0, programs = 1, chunks
     else:
         padded, start, counts_in, new_emit, outs = _launch_exchange(
-            lambda: _exchange_partition_fn(mesh, block, cb)(
+            lambda: _exchange_partition_fn(mesh, block, cb, part)(
                 payload, targets, emit))
         k0, programs = 0, chunks + 1
     step = _exchange_chunk_fn(mesh, block, cb)
@@ -515,7 +680,7 @@ def _dispatch_chunked(ctx: CylonContext, block: int, cb: int,
             if leaf is not None and \
                     getattr(leaf, "is_deleted", lambda: False)():
                 padded, start, counts_in, new_emit, outs = \
-                    _exchange_partition_fn(mesh, block, cb)(
+                    _exchange_partition_fn(mesh, block, cb, part)(
                         payload, targets, emit)
                 for j in range(k):
                     outs = step(padded, start, outs, np.int32(j))
@@ -539,7 +704,8 @@ def _record_chunked(sp, chunks: int, cb: int, programs: int) -> None:
 
 
 @counted_cache
-def _exchange_padded_pair_fn(mesh, block1: int, block2: int):
+def _exchange_padded_pair_fn(mesh, block1: int, block2: int,
+                             part1: str = "sort", part2: str = "sort"):
     """BOTH sides of a two-table shuffle in ONE compiled program — one
     dispatch instead of two, and XLA schedules the two bucket sorts and
     collective pairs together (the distributed join's composition cost
@@ -549,12 +715,14 @@ def _exchange_padded_pair_fn(mesh, block1: int, block2: int):
     spec = P(axis)
 
     def kernel(p1, t1, e1, p2, t2, e2):
-        o1 = _padded_body(axis, world, block1, p1, t1, e1)
-        o2 = _padded_body(axis, world, block2, p2, t2, e2)
+        o1 = _padded_body(axis, world, block1, p1, t1, e1, part1)
+        o2 = _padded_body(axis, world, block2, p2, t2, e2, part2)
         return o1 + o2
 
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
-                             out_specs=spec))
+    # any pallas side forces the unchecked shard_map build (a mixed
+    # sort+pallas pair still contains a pallas_call)
+    part = part1 if part1 != "sort" else part2
+    return _shard_map_for(part, kernel, mesh, (spec,) * 6, spec)
 
 
 def exchange_pair(payload1, targets1, emit1, counts1,
@@ -620,12 +788,17 @@ def exchange_pair(payload1, targets1, emit1, counts1,
         pair_stats = _skew.SkewStats.from_counts(
             np.asarray(counts1) + np.asarray(counts2)) \
             if counts1 is not None and counts2 is not None else None
+        part1 = _partition_path(ctx.mesh, world, payload1)
+        part2 = _partition_path(ctx.mesh, world, payload2)
         with _span("shuffle.exchange_pair", seq, world=world,
                    mode="padded", rows=rows, bytes_moved=nbytes) as sp:
             if pair_stats is not None:
                 sp.set(**pair_stats.span_attrs())
+            # one decision per side; the fused program partitions both
+            _record_partition(sp, part1, part2)
             res = _launch_exchange(
-                lambda: _exchange_padded_pair_fn(ctx.mesh, b1, b2)(
+                lambda: _exchange_padded_pair_fn(ctx.mesh, b1, b2,
+                                                 part1, part2)(
                     payload1, targets1, emit1, payload2, targets2,
                     emit2))
         _record_exchange(rows, nbytes)
@@ -900,11 +1073,13 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
         if skew_stats is not None:
             sp.set(**skew_stats.span_attrs())
         if padded_ok:
+            part = _partition_path(ctx.mesh, world, payload)
+            _record_partition(sp, part)
             cb, chunks = _chunk_plan(block_p, world, row_bytes)
             if chunks > 1:
                 out, new_emit, counts_in, programs = _dispatch_chunked(
                     ctx, block_p, cb, chunks, payload, targets, emit,
-                    fuse)
+                    fuse, part)
                 _record_chunked(sp, chunks, cb, programs)
                 _record_exchange(rows_live, nbytes, programs)
                 return out, new_emit, cap_padded, {
@@ -912,7 +1087,7 @@ def exchange(payload: Dict[str, jnp.ndarray], targets: jnp.ndarray,
                     "counts_in": counts_in, "chunks": chunks}
             out, new_emit, counts_in = _launch_exchange(
                 lambda: _exchange_padded_fn(
-                    ctx.mesh, block_p)(payload, targets, emit))
+                    ctx.mesh, block_p, part)(payload, targets, emit))
             _record_exchange(rows_live, nbytes)
             return out, new_emit, cap_padded, {
                 "mode": "padded", "block": block_p, "counts_in": counts_in}

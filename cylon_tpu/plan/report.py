@@ -212,6 +212,9 @@ class NodeMeasure:
     #                                acted on)
     retries: int = 0               # retried stages under this node's
     #                                own spans (resilience layer)
+    partition_path: Optional[str] = None  # partition path of this
+    #                                node's own exchanges ("pallas" |
+    #                                "sort" | "mixed" when they differ)
 
     @property
     def shuffles(self) -> int:
@@ -235,9 +238,11 @@ class NodeMeasure:
             est += f", calibrated={_human_bytes(self.calibrated_bytes)}"
         mem = "  [MEM]" if self.mem_warn else ""
         rt = f"  [RETRY×{self.retries}]" if self.retries else ""
+        part = f", part={self.partition_path}" \
+            if self.partition_path is not None else ""
         return (f"{self.desc}{pb}  (actual time={self.ms:.2f} ms, "
                 f"rows={self.rows}, bytes={_human_bytes(self.bytes)}"
-                f"{est}, shuffles={self.shuffles}{sk}){mem}{rt}")
+                f"{est}, shuffles={self.shuffles}{part}{sk}){mem}{rt}")
 
     def to_dict(self) -> dict:
         return {
@@ -252,6 +257,7 @@ class NodeMeasure:
             "est_source": self.est_source,
             "mem_warn": self.mem_warn,
             "retries": self.retries,
+            "partition_path": self.partition_path,
             "shuffles": self.shuffles, "labels": list(self.labels),
             "skew": dict(self.skew) if self.skew is not None else None,
             "children": [c.to_dict() for c in self.children],
@@ -281,6 +287,18 @@ def _fold_skew(spans) -> Optional[dict]:
             "rows_max": int(worst["shard_rows_max"]),
             "warn": bool(worst["skew_warn"]),
             "exchanges": n}
+
+
+def _fold_partition_path(spans):
+    """One partition-path label per node: the distinct
+    ``partition_path`` attrs over its own exchange spans ("pallas" or
+    "sort"; "mixed" when one lowering dispatched both), None when no
+    padded exchange ran."""
+    seen = {str(s.attrs["partition_path"]) for s in spans
+            if "partition_path" in getattr(s, "attrs", {})}
+    if not seen:
+        return None
+    return seen.pop() if len(seen) == 1 else "mixed"
 
 
 def build_measures(node: ir.PlanNode, recs: Dict[int, object],
@@ -328,17 +346,19 @@ def build_measures(node: ir.PlanNode, recs: Dict[int, object],
     own = [labels[i] for i in own_idx]
     skew = None
     retries = 0
+    part = None
     if spans is not None:
-        skew = _fold_skew(
-            [spans[i] for i in own_idx
-             if spans[i].name.startswith("shuffle.exchange")])
+        ex_spans = [spans[i] for i in own_idx
+                    if spans[i].name.startswith("shuffle.exchange")]
+        skew = _fold_skew(ex_spans)
+        part = _fold_partition_path(ex_spans)
         # retried stages annotate their enclosing span (resilience
         # retry loop) — fold them so the node renders [RETRY×n]
         retries = sum(int(spans[i].attrs.get("retries", 0))
                       for i in own_idx)
     return NodeMeasure(executed=True, ms=r.ms, rows=r.rows,
                        bytes=r.nbytes, labels=own, skew=skew,
-                       retries=retries, **base)
+                       retries=retries, partition_path=part, **base)
 
 
 @dataclass

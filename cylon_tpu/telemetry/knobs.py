@@ -200,6 +200,13 @@ declare("CYLON_EXCHANGE_CHUNK_BYTES", 1 << 26, "int",
         "(across all destinations); the chunk block is pow2-floored "
         "from it and the chunk count is capped at MAX_CHUNKS per "
         "exchange", lo=1 << 12)
+declare("CYLON_PARTITION_KERNEL", "auto", "str",
+        "partition path of the padded exchange: auto routes to the "
+        "fused Pallas histogram+scatter kernel on TPU (small worlds) "
+        "and the XLA stable sort elsewhere; sort forces the sort "
+        "everywhere (the exact pre-kernel program); pallas forces the "
+        "kernel (Pallas interpreter off-TPU — tests). Bit-identical "
+        "on every live row either way")
 
 # plan/
 declare("CYLON_TPU_VERIFY_PLANS", False, "bool",

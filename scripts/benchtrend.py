@@ -69,6 +69,7 @@ LOWER_IS_BETTER = {"compile.distinct_kernel_signatures",
                    # backend reference, so they enter the gate for
                    # real once TPU rounds resume (r05 is cpu-fallback)
                    "shuffle_pipeline.exchange_wall_s",
+                   "shuffle_pipeline.partition_wall_s",
                    "shuffle_pipeline.collective_launches"}
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -136,6 +137,7 @@ def flatten_metrics(parsed: Optional[dict]) -> Dict[str, float]:
                             ("gbps_per_chip", "gbps"),
                             ("speedup", "speedup"),
                             ("exchange_wall_s", "exchange_wall_s"),
+                            ("partition_wall_s", "partition_wall_s"),
                             ("collective_launches",
                              "collective_launches"),
                             ("join_rows_per_s", "join_rows_per_s"),

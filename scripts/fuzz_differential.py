@@ -80,6 +80,13 @@ def one_case(seed):
     os.environ["CYLON_EXCHANGE_OVERLAP"] = "1" if overlap else "0"
     if overlap:
         os.environ["CYLON_EXCHANGE_CHUNK_BYTES"] = "4096"
+    # …and, orthogonally, the partition path: "pallas" runs the fused
+    # hash+bucket+scatter kernel under the Pallas interpreter on CPU,
+    # "sort" the XLA stable sort — differential evidence across the
+    # full knob matrix (overlap × partition), every combination must
+    # agree with local AND pandas
+    partition = "pallas" if bool(rng.integers(0, 2)) else "sort"
+    os.environ["CYLON_PARTITION_KERNEL"] = partition
 
     old = _strings.DICT_MAX_VOCAB
     if force_vb:
@@ -147,7 +154,8 @@ def one_case(seed):
         _strings.DICT_MAX_VOCAB = old
         os.environ.pop("CYLON_EXCHANGE_OVERLAP", None)
         os.environ.pop("CYLON_EXCHANGE_CHUNK_BYTES", None)
-    return kind, jt, force_vb, overlap
+        os.environ.pop("CYLON_PARTITION_KERNEL", None)
+    return kind, jt, force_vb, overlap, partition
 
 
 def main(n_cases, base):
@@ -155,8 +163,8 @@ def main(n_cases, base):
     for i in range(n_cases):
         seed = base + i
         try:
-            kind, jt, fv, ov = one_case(seed)
-            print(f"case {seed}: ok ({kind}, {jt}, vb={fv}, "
+            kind, jt, fv, ov, pk = one_case(seed)
+            print(f"case {seed}: ok ({kind}, {jt}, vb={fv}, part={pk}, "
                   f"overlap={ov})", flush=True)
         except AssertionError as e:
             bad += 1

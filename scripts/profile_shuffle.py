@@ -97,6 +97,48 @@ def main(log2n: int = 24) -> dict:
         sync(sort_fn(tkey, tuple(payload.values())))
     res["sort_floor_s"] = best_of(sort_floor)
 
+    # phase 3c: raw-copy HBM bandwidth floor — one jitted read+write
+    # pass over the payload (x+0 defeats aliasing), the wall a
+    # bandwidth-bound partition cannot beat. partition walls land
+    # between this and sort_floor_s; the Pallas kernel's win is
+    # (partition_sort_s − partition_pallas_s) once TPU rounds resume.
+    copy_fn = jax.jit(lambda p: jax.tree.map(lambda x: x + 0, p))
+
+    def copy_floor():
+        sync(copy_fn(payload))
+    res["copy_floor_s"] = best_of(copy_floor)
+
+    # phase 3d: the partition wall per path — the unfused partition
+    # program (bucket sort | fused Pallas hash+bucket+scatter kernel),
+    # isolated from the chunk stream. The pallas leg runs only where
+    # the kernel compiles (TPU); the interpreter path would measure the
+    # interpreter, not the chip.
+    on_tpu = jax.devices()[0].platform == "tpu"
+    p_ok0, blk0, _ = _shuffle._padded_route(counts, payload, world,
+                                            ctx.memory_pool
+                                            .comm_budget_bytes())
+    routed_part = _shuffle._partition_path(ctx.mesh, world, payload)
+    # artifact carries the PUBLIC label (pallas|sort) — "interp" is an
+    # internal spelling no other surface exposes
+    res["partition_path"] = _shuffle.partition_path_label(routed_part)
+    if p_ok0 and blk0 >= 16 and world >= 2:
+        cb0 = _shuffle._pow2_floor(max(blk0 // 8, 1))
+
+        def time_partition(part):
+            fn = _shuffle._exchange_partition_fn(ctx.mesh, blk0, cb0,
+                                                 part)
+
+            def run():
+                sync(fn(payload, targets, emit)[0])
+            return best_of(run)
+
+        res["partition_sort_s"] = time_partition("sort")
+        res["partition_pallas_s"] = time_partition("pallas") \
+            if on_tpu else None
+    else:
+        res["partition_sort_s"] = None
+        res["partition_pallas_s"] = None
+
     # end to end, default routing (round-5: at W=1 this is the FUSED
     # count+exchange — in-program counts, device-side all-live identity)
     def full():
@@ -121,7 +163,8 @@ def main(log2n: int = 24) -> dict:
         cb, chunks = _shuffle._chunk_plan(block, world, row_bytes_p)
         if chunks == 1:
             cb, chunks = block // 8, 8
-        part_fn = _shuffle._exchange_partition_fn(ctx.mesh, block, cb)
+        part_fn = _shuffle._exchange_partition_fn(
+            ctx.mesh, block, cb, routed_part)
         step_fn = _shuffle._exchange_chunk_fn(ctx.mesh, block, cb)
 
         def partition_only():

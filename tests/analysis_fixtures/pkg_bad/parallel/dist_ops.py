@@ -39,3 +39,7 @@ def _host_helper_fn(axis):  # cylint: disable=collectives/uncataloged-factory
 
 def _chunk_rogue_fn(mesh, block, chunk_block):  # SEEDED: collectives/uncataloged-factory (chunked-path control)
     return mesh
+
+
+def _partition_rogue_fn(mesh, block, part):  # SEEDED: collectives/uncataloged-factory (partition-path control)
+    return mesh

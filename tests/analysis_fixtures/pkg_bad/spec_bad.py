@@ -92,3 +92,20 @@ def run_chunked(mesh, counts):
     _chunk_exchange_fn(mesh, block, pow2_floor(block // 4))  # clean
     cb = int(np.asarray(jax.device_get(counts)).sum())
     _chunk_exchange_fn(mesh, block, cb)     # SEEDED: unbucketed chunk block
+
+
+@counted_cache
+def _partition_exchange_fn(mesh, block: int, part: str):
+    """Partition-path-shaped factory: the capacity must arrive bucketed
+    and the path string is structural (finite literal set)."""
+    def kernel(x):
+        return x
+
+    return jax.jit(kernel)
+
+
+def run_partitioned(mesh, counts):
+    block = bucket_cap(int(np.asarray(jax.device_get(counts)).max()))
+    _partition_exchange_fn(mesh, block, "pallas")   # clean: bucketed+path
+    raw = int(np.asarray(jax.device_get(counts)).max())
+    _partition_exchange_fn(mesh, raw, "sort")  # SEEDED: raw capacity key

@@ -244,12 +244,14 @@ def test_collectives_uncataloged_factory_fixture():
     got = {(f.path, f.rule) for f in res.findings}
     assert got == {("parallel/dist_ops.py",
                     "collectives/uncataloged-factory")}, res.format_text()
-    assert len(res.findings) == 2
+    assert len(res.findings) == 3
     names = " ".join(f.message for f in res.findings)
     assert "_rogue_kernel_fn" in names
     # the chunked-exchange-shaped factory is swept the same way: a new
     # chunk program outside the catalog is a finding, not a note
     assert "_chunk_rogue_fn" in names
+    # …as is a partition-path-shaped factory (the Pallas-kernel route)
+    assert "_partition_rogue_fn" in names
     # _host_helper_fn opted out on its def line — suppressed, visible
     assert res.suppressed == 1
 
@@ -485,6 +487,9 @@ def test_specialization_fixture_reports_exactly_seeded():
         # pow2_floor chunk-block call stays clean, the raw runtime
         # chunk block is a finding
         ("spec_bad.py", 94, "specialization/unbucketed-capacity"),
+        # the partition-path-shaped factory: bucketed block + literal
+        # path string clean, the raw capacity key a finding
+        ("spec_bad.py", 111, "specialization/unbucketed-capacity"),
     }, res.format_text()
     # the reasoned per-line disable on the env-sourced cap counted
     assert res.suppressed == 1

@@ -67,29 +67,38 @@ def test_shuffle_pipeline_metrics_flatten_and_gate_lower(tmp_path):
     fails."""
     flat = benchtrend.flatten_metrics(_artifact(
         1e6, suite={"shuffle_pipeline": {"exchange_wall_s": 0.8,
+                                         "partition_wall_s": 0.3,
                                          "collective_launches": 4,
                                          "gbps_per_chip": 2.0}}))
     assert flat["shuffle_pipeline.exchange_wall_s"] == 0.8
+    assert flat["shuffle_pipeline.partition_wall_s"] == 0.3
     assert flat["shuffle_pipeline.collective_launches"] == 4
     assert flat["shuffle_pipeline.gbps"] == 2.0
     assert "shuffle_pipeline.exchange_wall_s" in \
+        benchtrend.LOWER_IS_BETTER
+    assert "shuffle_pipeline.partition_wall_s" in \
         benchtrend.LOWER_IS_BETTER
     assert "shuffle_pipeline.collective_launches" in \
         benchtrend.LOWER_IS_BETTER
     win = _write_rounds(tmp_path, {
         1: _artifact(1e6, suite={"shuffle_pipeline": {
-            "exchange_wall_s": 0.8, "collective_launches": 8}}),
+            "exchange_wall_s": 0.8, "partition_wall_s": 0.4,
+            "collective_launches": 8}}),
         2: _artifact(1e6, suite={"shuffle_pipeline": {
-            "exchange_wall_s": 0.4, "collective_launches": 4}})})
+            "exchange_wall_s": 0.4, "partition_wall_s": 0.1,
+            "collective_launches": 4}})})
     assert benchtrend.find_regressions(benchtrend.load_rounds(win)) == []
     lose = _write_rounds(tmp_path, {
         1: _artifact(1e6, suite={"shuffle_pipeline": {
-            "exchange_wall_s": 0.4, "collective_launches": 4}}),
+            "exchange_wall_s": 0.4, "partition_wall_s": 0.1,
+            "collective_launches": 4}}),
         2: _artifact(1e6, suite={"shuffle_pipeline": {
-            "exchange_wall_s": 0.8, "collective_launches": 8}})})
+            "exchange_wall_s": 0.8, "partition_wall_s": 0.4,
+            "collective_launches": 8}})})
     regs = {m for m, *_ in benchtrend.find_regressions(
         benchtrend.load_rounds(lose))}
     assert "shuffle_pipeline.exchange_wall_s" in regs
+    assert "shuffle_pipeline.partition_wall_s" in regs
     assert "shuffle_pipeline.collective_launches" in regs
 
 
