@@ -343,6 +343,118 @@ def bench_shuffle_pipeline(ctx, n_rows: int, iters: int) -> dict:
     }
 
 
+def bench_adaptive_join(ctx, n_rows: int, iters: int) -> dict:
+    """Adaptive join execution (PR 15): the cold (exploratory shuffle)
+    join vs the warm (learned broadcast) join on a 1000:1 size ratio,
+    plus the Zipfian-keyed salted vs unsalted exchange. Gated metrics
+    (scripts/benchtrend.py): ``broadcast_speedup`` (HIGHER — warm wall
+    over cold wall) and ``salted_imbalance`` (LOWER_IS_BETTER — the
+    salted exchange's max/mean shard-row imbalance; unsalted rides
+    beside it as ``unsalted_imbalance`` for the delta). The warm run
+    must dispatch strictly fewer collective launches than the cold run
+    AND move zero payload-exchange bytes — both pinned in the
+    artifact."""
+    import os
+
+    import jax
+
+    import cylon_tpu as ct
+    from cylon_tpu import plan, telemetry
+    from cylon_tpu.parallel import dist_ops
+    from cylon_tpu.telemetry import stats as stats_mod
+
+    rng = np.random.default_rng(21)
+    world = max(ctx.get_world_size(), 1)
+    n_build = max(n_rows // 1000, 64)
+    keys = max(n_build // 2, 1)
+    left = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, keys, n_rows).astype(np.int32),
+        "v": rng.normal(size=n_rows).astype(np.float32)})
+    right = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, keys, n_build).astype(np.int32),
+        "w": rng.normal(size=n_build).astype(np.float32)})
+
+    def pipe():
+        return plan.scan(left).join(plan.scan(right), on="k")
+
+    def snap(name):
+        return telemetry.metrics_snapshot().get(name, 0)
+
+    def one():
+        _sync(pipe().execute())
+
+    stats_mod.reset()
+    old = {k: os.environ.get(k)
+           for k in ("CYLON_JOIN_ALGORITHM", "CYLON_STATS_MIN_OBS")}
+    os.environ["CYLON_STATS_MIN_OBS"] = "2"
+    try:
+        # cold leg: the forced-shuffle program (the exact pre-adaptive
+        # plan) — its executions double as the learning runs
+        os.environ["CYLON_JOIN_ALGORITHM"] = "shuffle"
+        cold_s = _time(one, iters)
+        l0, b0 = snap("cylon_collective_launches_total"), \
+            snap("cylon_shuffle_bytes_total")
+        one()
+        cold_launches = snap("cylon_collective_launches_total") - l0
+        cold_bytes = snap("cylon_shuffle_bytes_total") - b0
+        # warm leg: the learned statistics rewrite the shape
+        os.environ["CYLON_JOIN_ALGORITHM"] = "auto"
+        went_broadcast = "algo=broadcast" in pipe().explain()
+        warm_s = _time(one, iters)
+        l0, b0 = snap("cylon_collective_launches_total"), \
+            snap("cylon_shuffle_bytes_total")
+        one()
+        warm_launches = snap("cylon_collective_launches_total") - l0
+        warm_bytes = snap("cylon_shuffle_bytes_total") - b0
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # salted vs unsalted exchange under a Zipfian key (70% hot)
+    zk = np.where(rng.random(n_rows) < 0.7, 7,
+                  rng.integers(0, 1 << 20, n_rows)).astype(np.int32)
+
+    def zipf():
+        return ct.Table.from_pydict(ctx, {
+            "k": zk, "v": np.arange(n_rows, dtype=np.float32)})
+
+    def imbalance(t):
+        em = np.asarray(jax.device_get(t.emit_mask()))
+        per = em.shape[0] // world
+        rows = [int(em[i * per:(i + 1) * per].sum())
+                for i in range(world)]
+        return max(rows) / max(sum(rows) / world, 1.0)
+
+    plain = dist_ops.shuffle(zipf(), ["k"])
+    unsalted_imb = imbalance(plain)
+    unsalted_s = _time(lambda: _sync(dist_ops.shuffle(zipf(), ["k"])),
+                       iters)
+    salted = dist_ops.shuffle(zipf(), ["k"], salted=True)
+    salted_imb = imbalance(salted)
+    salted_s = _time(
+        lambda: _sync(dist_ops.shuffle(zipf(), ["k"], salted=True)),
+        iters)
+    return {
+        "cold_shuffle_wall_s": _sig(cold_s),
+        "warm_broadcast_wall_s": _sig(warm_s),
+        "broadcast_speedup": _sig(cold_s / warm_s, 4) if warm_s else 0.0,
+        "went_broadcast": bool(went_broadcast),
+        "cold_collective_launches": int(cold_launches),
+        "warm_collective_launches": int(warm_launches),
+        "fewer_launches_warm": bool(warm_launches < cold_launches),
+        "cold_exchange_bytes": int(cold_bytes),
+        "warm_exchange_bytes": int(warm_bytes),
+        "build_rows": int(n_build),
+        "unsalted_wall_s": _sig(unsalted_s),
+        "salted_wall_s": _sig(salted_s),
+        "unsalted_imbalance": _sig(unsalted_imb, 4),
+        "salted_imbalance": _sig(salted_imb, 4),
+    }
+
+
 def bench_groupby(ctx, n_rows: int, iters: int) -> dict:
     import cylon_tpu as ct
 
@@ -846,6 +958,8 @@ def run(n_rows: int = 1 << 24, iters: int = 3, full: bool = True) -> dict:
              lambda: bench_shuffle_wide(ctx, n_rows, iters)),
             ("shuffle_pipeline",
              lambda: bench_shuffle_pipeline(ctx, n_rows, iters)),
+            ("adaptive_join",
+             lambda: bench_adaptive_join(ctx, n_rows // 4, iters)),
             ("hbm_blocked_join",
              lambda: bench_hbm_blocked_join(ctx, n_rows * 12,
                                             n_rows * 3)),

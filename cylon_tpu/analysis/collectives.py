@@ -273,6 +273,14 @@ def default_entry_points() -> List[EntryPoint]:
                        _sds((), i32)),
             factory="_exchange_chunk_fn"),
         EntryPoint(
+            # hot-key salted routing: targets+emit (+ the replicated
+            # warn-factor scalar) -> salted targets + stacked count
+            # matrices. salt=4 is the declared CYLON_SALT_FACTOR shape
+            "salted_targets", sh,
+            lambda m: S(m)._salted_targets_fn(m, 4),
+            lambda m: rows(i32, b) + (_sds((), jnp.float32),),
+            factory="_salted_targets_fn"),
+        EntryPoint(
             "string_hash", do, lambda m: D(m)._string_hash_fn(m, 4),
             lambda m: vb(), factory="_string_hash_fn"),
         EntryPoint(
@@ -322,6 +330,25 @@ def default_entry_points() -> List[EntryPoint]:
                        rows(i32, jnp.float32), rows(b, b),
                        rows(i32,), rows(b,)),
             factory="_join_mat_fn"),
+        EntryPoint(
+            # broadcast-hash join (adaptive execution): the build
+            # side's key bits all_gather inside the program, probe
+            # rows plan per shard against the replicated table
+            "bcast_join_plan", do,
+            lambda m: _bcast_join_factory(D(m), m),
+            lambda m: ((_sds(N, u32),), _sds(N, b), _sds(N, b),
+                       (_sds(N, u32),), _sds(N, b), _sds(N, b)),
+            factory="_bcast_join_plan_fn"),
+        EntryPoint(
+            # ...and its materialize program: build payload lanes
+            # re-gathered, match runs expanded at host-chosen capacity
+            "bcast_join_mat", do,
+            lambda m: _bcast_join_mat_factory(D(m), m),
+            lambda m: (_sds(N, i32), _sds(N, i32), _sds(N, i32),
+                       _sds(N, b), _sds(N, b),
+                       rows(i32, jnp.float32), rows(b, b),
+                       rows(i32,), rows(b,)),
+            factory="_bcast_join_mat_fn"),
         EntryPoint(
             "setop_count", do, lambda m: D(m)._setop_count_fn(m),
             lambda m: ((_sds(N, u32),), _sds(N, b),
@@ -388,6 +415,16 @@ def _join_factory(dist_ops, mesh, jt_name):
 def _join_mat_factory(dist_ops, mesh):
     from ..ops import join as _join
     return dist_ops._join_mat_fn(mesh, _join.JoinType.INNER, 16, 0)
+
+
+def _bcast_join_factory(dist_ops, mesh):
+    from ..ops import join as _join
+    return dist_ops._bcast_join_plan_fn(mesh, _join.JoinType.INNER)
+
+
+def _bcast_join_mat_factory(dist_ops, mesh):
+    from ..ops import join as _join
+    return dist_ops._bcast_join_mat_fn(mesh, _join.JoinType.LEFT, 16)
 
 
 def _setop_mat_factory(dist_ops, mesh):

@@ -47,11 +47,11 @@ from ..ops import order as _order
 from ..ops import setops as _setops
 from ..status import Code, CylonPlanError
 from ..telemetry import annotate as _annotate, counted_cache, \
-    ledger as _ledger, phase as _phase, record_host_sync as _host_sync, \
-    span as _span
+    counter as _counter, ledger as _ledger, phase as _phase, \
+    record_host_sync as _host_sync, span as _span
 from . import shard
 from ..benchutils import bucket_cap as _bucket_cap
-from ..util import capacity as _capacity
+from ..util import capacity as _capacity, pow2_floor as _pow2_floor
 from .shuffle import count_pair, exchange, exchange_pair, \
     replicated_gather
 
@@ -748,11 +748,29 @@ def _groupby_fn(mesh, ops: Tuple[_groupby.AggregationOp, ...],
 # shuffle / partition public API
 # ---------------------------------------------------------------------------
 
-def shuffle(table: Table, hash_columns: Sequence) -> Table:
+def shuffle(table: Table, hash_columns: Sequence,
+            salted: bool = False) -> Table:
     """Repartition rows by key hash (reference: cylon::Shuffle,
     table.cpp:162-236). Tables already hash-placed on the same keys
     (a previous shuffle, or shard.distribute_by_key host ingest) pass
-    through without an exchange."""
+    through without an exchange.
+
+    ``salted``: the hot-key load-balancing variant (adaptive
+    execution): the salted-targets program decides on device which
+    destinations are hot (receive total past CYLON_SKEW_WARN_FACTOR x
+    the mean, from the true global count matrix) and spreads exactly
+    those destinations' rows across CYLON_SALT_FACTOR consecutive
+    shards — bounding the max shard under Zipfian keys. The salt is
+    routing-only (nothing to strip on the receive side), but the
+    output carries NO placement witness: salted placement is
+    positional, and every downstream consumer must re-establish
+    placement itself. Skew observability records the RAW
+    (pre-mitigation) count matrix, so the planner's salting decision
+    reads true key skew, never its own mitigation."""
+    from .shuffle import salted_exchange_targets
+    from ..telemetry import knobs as _knobs
+    from ..telemetry import skew as _skew
+
     ctx = table._ctx
     world = ctx.get_world_size()
     if world == 1:
@@ -761,11 +779,33 @@ def shuffle(table: Table, hash_columns: Sequence) -> Table:
     idxs = [t._col_index(c) for c in hash_columns]
     sig = shard.partition_signature([t._columns[i] for i in idxs], idxs,
                                     world)
-    if sig is not None and t._hash_partitioned == sig:
+    # pow2_floor: the salt factor keys the compiled salted-targets
+    # program (1 per octave, specialization-clean); the effective
+    # spread is therefore the pow2 floor of CYLON_SALT_FACTOR
+    salt = _pow2_floor(max(int(_knobs.get("CYLON_SALT_FACTOR")), 1)) \
+        if salted else 0
+    salted = salted and salt >= 2
+    if sig is not None and t._hash_partitioned == sig and not salted:
         return t
     targets = shard.pin(_partition_targets_dist(
         ctx, [t._columns[i] for i in idxs]), ctx)
     emit = shard.pin(t.emit_mask(), ctx)
+    if salted:
+        warn = float(_knobs.get("CYLON_SKEW_WARN_FACTOR"))
+        targets, counts, raw = salted_exchange_targets(
+            targets, emit, ctx, salt, warn)
+        targets = shard.pin(targets, ctx)
+        _counter("cylon_salted_exchanges_total").inc()
+        raw_stats = _skew.SkewStats.from_counts(raw)
+        _annotate(salted=True, salt_factor=salt,
+                  skew_raw=round(raw_stats.imbalance, 3)
+                  if raw_stats is not None else None)
+        cols, new_emit, _x = _exchange_table(t, targets, emit, ctx,
+                                             counts=counts)
+        result = Table(cols, ctx, new_emit)
+        # NO witness: hot keys are spread positionally across shards
+        table._free_if_unretained()
+        return _ledger.track(result, "shuffle")
     cols, new_emit, _x = _exchange_table(t, targets, emit, ctx,
                                          dense=t.row_mask is None)
     result = Table(cols, ctx, new_emit)
@@ -897,8 +937,12 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
     if world == 1 and not (force_exchange and ctx.is_distributed()):
         # reference parity: world==1 short-circuits to the local join
         # (table.cpp:662-669)
+        _counter("cylon_join_algorithm_total", {"algo": "local"}).inc()
         return _ledger.track(table_mod.join(left, right, config),
                              "distributed_join")
+    # the runtime-honest algorithm census (adaptive execution: which
+    # joins actually went broadcast — see broadcast_hash_join)
+    _counter("cylon_join_algorithm_total", {"algo": "shuffle"}).inc()
     exact_pairs = []
     if getattr(config, "exact", False):
         from ..data.strings import EXACT_KEY_WORDS
@@ -1009,6 +1053,10 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
                                      b_desc, br, hash_mode)(
                     lkb, lkv, lemit, rkb, rkv, remit,
                     ldat, lval, rdat, rval)
+            # the plan program's replicated counts-gather is a real
+            # collective dispatch — counted, so the adaptive bench's
+            # launch comparison is honest on both algorithms
+            _counter("cylon_collective_launches_total").inc()
             cm = np.asarray(jax.device_get(rep_counts)).reshape(world, -1)
             _host_sync("join.plan")
         if not (hash_mode and int(cm[:, 3].sum()) > 0):
@@ -1026,6 +1074,9 @@ def distributed_join(left: Table, right: Table, config: _join.JoinConfig,
         with _phase("distributed_join.plan", seq):
             counts2, lo, m, bperm, un_mask = _join_plan_fn(ctx.mesh, jt)(
                 lkb, lkv, lemit, rkb, rkv, remit)
+            # replicated counts-gather: a counted collective dispatch
+            # (see the stream-plan branch above)
+            _counter("cylon_collective_launches_total").inc()
             aemit = remit if jt == _join.JoinType.RIGHT else lemit
             # counts2 is the replicated [world, 2] matrix of per-shard
             # [n_primary, n_unmatched_b]; capacity = worst shard (all
@@ -1177,6 +1228,56 @@ def _exact_dict_redo(left: Table, right: Table, config: _join.JoinConfig,
 # size(probe+build) through the all-to-all — the win when the build side
 # is small or the probe side is large and already resident.
 # ---------------------------------------------------------------------------
+
+
+def _prep_join_side(ctx: CylonContext, t: Table, cols, other_cols):
+    """One join side's per-shard kernel operands: key bit arrays +
+    combined key validity + emit, plus the payload data/validity lanes
+    with every (short) varbytes column's word lanes APPENDED as extra
+    fixed-width lanes (the ArrowJoin trick — strings ride the
+    fixed-width machinery; ``lane_slots`` maps column -> (first lane
+    index, lane count) for the rebuild). Shared by the ring join
+    (lanes rotate with the visiting block) and the broadcast join
+    (lanes gather with the replicated build side)."""
+    bits, kv, _h = _dist_key_bits(ctx, cols, other_cols)
+    bits = tuple(shard.pin(b, ctx) for b in bits)
+    kv = shard.pin(kv, ctx)
+    emit = shard.pin(t.emit_mask(), ctx)
+    dat = [shard.pin(c.data, ctx) for c in t._columns]
+    val = [shard.pin(c.valid_mask(), ctx) for c in t._columns]
+    lane_slots = {}
+    for i, c in enumerate(t._columns):
+        if c.is_varbytes:
+            vb = c.varbytes
+            lanes = _word_lanes_fn(ctx.mesh, vb.max_words)(
+                shard.pin(vb.words, ctx), shard.pin(vb.starts, ctx),
+                shard.pin(vb.lengths, ctx))
+            lane_slots[i] = (len(dat), vb.max_words)
+            dat.extend(lanes)
+            val.extend([shard.pin(c.valid_mask(), ctx)] * vb.max_words)
+    return bits, kv, emit, tuple(dat), tuple(val), lane_slots
+
+
+def _rebuild_join_side(ctx: CylonContext, slabs_d, slabs_v, t: Table,
+                       lane_slots, prefix: str):
+    """Columns back out of one side's materialized slabs: varbytes
+    columns reassemble from their word lanes (unmatched/dead/null slab
+    rows carry garbage lanes — their lengths zero via the hit-AND-valid
+    mask; never-written slab rows are zero-initialized)."""
+    cols = []
+    for i, c in enumerate(t._columns):
+        d, v = slabs_d[i], slabs_v[i]
+        if c.is_varbytes:
+            off, k = lane_slots[i]
+            lens = jnp.where(v, d, 0)
+            vb = _from_lanes_sharded(
+                ctx, [slabs_d[off + q] for q in range(k)], lens)
+            cols.append(Column(vb.lengths, c.dtype, v, None,
+                               f"{prefix}-{i}", varbytes=vb))
+        else:
+            cols.append(Column(d, c.dtype, v, c.dictionary,
+                               f"{prefix}-{i}"))
+    return cols
 
 
 def _varying(axis, tree):
@@ -1347,32 +1448,15 @@ def distributed_join_ring(left: Table, right: Table,
         a_t, a_cols, b_t, b_cols = left_d, lcols, right_d, rcols
     emit_un_a = jt != _join.JoinType.INNER
 
-    def prep(t, cols, other_cols):
-        # varbytes keys become per-shard word lanes (byte-exact) or the
-        # content-hash quad; either way the bit arrays rotate like any
-        # fixed lane. Short varbytes PAYLOADS ride as appended word
-        # lanes (the ArrowJoin analog now streams whole tables incl.
-        # strings, reference arrow_join.hpp:50-198).
-        bits, kv, _h = _dist_key_bits(ctx, cols, other_cols)
-        bits = tuple(shard.pin(b, ctx) for b in bits)
-        kv = shard.pin(kv, ctx)
-        emit = shard.pin(t.emit_mask(), ctx)
-        dat = [shard.pin(c.data, ctx) for c in t._columns]
-        val = [shard.pin(c.valid_mask(), ctx) for c in t._columns]
-        lane_slots = {}
-        for i, c in enumerate(t._columns):
-            if c.is_varbytes:
-                vb = c.varbytes
-                lanes = _word_lanes_fn(ctx.mesh, vb.max_words)(
-                    shard.pin(vb.words, ctx), shard.pin(vb.starts, ctx),
-                    shard.pin(vb.lengths, ctx))
-                lane_slots[i] = (len(dat), vb.max_words)
-                dat.extend(lanes)
-                val.extend([shard.pin(c.valid_mask(), ctx)] * vb.max_words)
-        return bits, kv, emit, tuple(dat), tuple(val), lane_slots
-
-    abits, akv, aemit, adat, aval, a_lane_slots = prep(a_t, a_cols, b_cols)
-    bbits, bkv, bemit, bdat, bval, b_lane_slots = prep(b_t, b_cols, a_cols)
+    # varbytes keys become per-shard word lanes (byte-exact) or the
+    # content-hash quad; either way the bit arrays rotate like any
+    # fixed lane. Short varbytes PAYLOADS ride as appended word lanes
+    # (the ArrowJoin analog now streams whole tables incl. strings,
+    # reference arrow_join.hpp:50-198).
+    abits, akv, aemit, adat, aval, a_lane_slots = _prep_join_side(
+        ctx, a_t, a_cols, b_cols)
+    bbits, bkv, bemit, bdat, bval, b_lane_slots = _prep_join_side(
+        ctx, b_t, b_cols, a_cols)
 
     seq = ctx.get_next_sequence()
     with _phase("ring_join.count", seq):
@@ -1405,33 +1489,15 @@ def distributed_join_ring(left: Table, right: Table,
     if skewed or over_budget:
         return distributed_join(left, right, config)
 
+    _counter("cylon_join_algorithm_total", {"algo": "ring"}).inc()
     with _phase("ring_join.materialize", seq):
         sa, sav, sb, sbv, emit = _ring_mat_fn(
             ctx.mesh, emit_un_a, cap_step, cap_extra, len(abits))(
             abits, akv, aemit, bbits, bkv, bemit, adat, aval, bdat, bval)
 
-    def build_side(slabs_d, slabs_v, t, lane_slots, prefix):
-        cols = []
-        for i, c in enumerate(t._columns):
-            d, v = slabs_d[i], slabs_v[i]
-            if c.is_varbytes:
-                off, k = lane_slots[i]
-                # unmatched/dead/null slab rows carry garbage lanes —
-                # zero their lengths (v is src-validity AND hit; slab
-                # init is zero for never-written rows)
-                lens = jnp.where(v, d, 0)
-                vb = _from_lanes_sharded(
-                    ctx, [slabs_d[off + q] for q in range(k)], lens)
-                cols.append(Column(vb.lengths, c.dtype, v, None,
-                                   f"{prefix}-{i}", varbytes=vb))
-            else:
-                cols.append(Column(d, c.dtype, v, c.dictionary,
-                                   f"{prefix}-{i}"))
-        return cols
-
     na = a_t.column_count
-    a_cols_out = build_side(sa, sav, a_t, a_lane_slots, "a")
-    b_cols_out = build_side(sb, sbv, b_t, b_lane_slots, "b")
+    a_cols_out = _rebuild_join_side(ctx, sa, sav, a_t, a_lane_slots, "a")
+    b_cols_out = _rebuild_join_side(ctx, sb, sbv, b_t, b_lane_slots, "b")
     if jt == _join.JoinType.RIGHT:
         cols = b_cols_out + a_cols_out
         nl = b_t.column_count
@@ -1444,6 +1510,220 @@ def distributed_join_ring(left: Table, right: Table,
     left._free_if_unretained()
     right._free_if_unretained()
     return _ledger.track(result, "distributed_join_ring")
+
+
+# ---------------------------------------------------------------------------
+# broadcast-hash join (adaptive execution, ROADMAP item 1): when the
+# planner has MEASURED one side small (stats warehouse, see
+# plan/optimizer.adapt_from_stats), the all-to-all that dominates every
+# distributed op per PAPER.md's local/shuffle/local composition is
+# elided entirely — the build side is replicated to every shard via the
+# counted-gather discipline (`replicated_gather`, the same psum one-hot
+# trick `_join_plan_fn` uses for its counts) INSIDE the per-shard join
+# program, and every shard probes its RESIDENT rows against the full
+# build table with the same local join kernels. Zero payload
+# all-to-all, zero probe-side movement: the probe side's
+# `_hash_partitioned` witness survives the join unchanged.
+# ---------------------------------------------------------------------------
+
+
+def _gather_full(x, axis, world):
+    """Per-shard [n, ...] leaf -> the FULL [world*n, ...] array
+    replicated on every shard, rows in global (shard-major) order.
+    psum-of-one-hot (replicated_gather) so shard_map's replication
+    checker can statically prove the result replicated; bools ride as
+    u8 (psum has no bool reduction)."""
+    if x.dtype == jnp.bool_:
+        g = replicated_gather(x.astype(jnp.uint8), axis, world)
+        return g.reshape((-1,) + x.shape[1:]).astype(jnp.bool_)
+    g = replicated_gather(x, axis, world)
+    return g.reshape((-1,) + x.shape[1:])
+
+
+@counted_cache
+def _bcast_join_plan_fn(mesh, join_type: _join.JoinType):
+    """Broadcast-join plan program: all_gather the (small) build
+    side's key bits inside the shard_map, then run the SAME fused-sort
+    join plan every shuffle join uses — probe rows per shard vs the
+    full build table. Counts come back replicated (every controller
+    process can fetch them, multi-host safe); the match arrays stay
+    sharded for the materialize program."""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+
+    def kernel(abits, akv, aemit, bbits, bkv, bemit):
+        bb = tuple(_gather_full(x, axis, world) for x in bbits)
+        bkv_f = _gather_full(bkv, axis, world)
+        bemit_f = _gather_full(bemit, axis, world)
+        counts2, lo, m, bperm, un_mask = _join.join_plan_keys(
+            abits, akv, aemit, bb, bkv_f, bemit_f, join_type)
+        return (replicated_gather(counts2, axis, world),
+                lo, m, bperm, un_mask)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 6,
+                             out_specs=(P(), spec, spec, spec, spec)))
+
+
+@counted_cache
+def _bcast_join_mat_fn(mesh, join_type: _join.JoinType, cap_p: int):
+    """Broadcast-join materialize program: re-gather the build side's
+    payload lanes (replication is recomputed, never cached — the build
+    side is small by the planner's measured evidence), expand the
+    match runs at the host-chosen capacity, and gather both sides.
+    Probe gathers stay shard-local; build gathers index the replicated
+    table."""
+    axis = mesh.axis_names[0]
+    world = mesh.devices.size
+    spec = P(axis)
+
+    def kernel(lo, m, bperm, un_mask, aemit, adat, aval, bdat, bval):
+        bdat_f = tuple(_gather_full(x, axis, world) for x in bdat)
+        bval_f = tuple(_gather_full(x, axis, world) for x in bval)
+        # join_type is INNER or LEFT here (probe is always the a side),
+        # so (lidx, ridx) == (aidx, bidx)
+        aidx, bidx, emit = _join.join_materialize_gids(
+            lo, m, bperm, un_mask, aemit, join_type, cap_p, 0)
+        aod, aov = _gather_side(adat, aval, aidx)
+        bod, bov = _gather_side(bdat_f, bval_f, bidx)
+        return aod, aov, bod, bov, emit
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(spec,) * 9,
+                             out_specs=spec))
+
+
+# sides a broadcast join may legally replicate, per join type: the
+# probe must cover every row the join can emit unmatched. THREE
+# deliberately-independent copies of this invariant exist — here (the
+# runtime gate), plan/optimizer._BROADCAST_SIDES (the rewrite's choice,
+# in preference order) and plan/verify._BROADCAST_SIDES (the
+# optimizer-independent soundness check) — because the layering
+# contracts forbid sharing (parallel never imports plan/, and the
+# verifier must not share code with the optimizer). Their agreement is
+# PINNED by tests/test_adaptive_join.py::test_broadcast_side_tables_agree;
+# change one, change all three.
+_BCAST_LEGAL_SIDES = {_join.JoinType.INNER: (0, 1),
+                      _join.JoinType.LEFT: (1,),
+                      _join.JoinType.RIGHT: (0,)}
+
+
+def _broadcast_eligible(left: Table, right: Table,
+                        config: _join.JoinConfig,
+                        build_side: int) -> Optional[str]:
+    """None when the broadcast path can run this join; otherwise the
+    reason it must fall back to the shuffle composition."""
+    from ..data.strings import EXACT_KEY_WORDS, LANE_WORDS_MAX
+
+    jt = config.type
+    legal = _BCAST_LEGAL_SIDES.get(jt, ())
+    if build_side not in legal:
+        return f"build_side={build_side} not replicable under {jt.name}"
+    if any(c.is_varbytes and c.varbytes.max_words > LANE_WORDS_MAX
+           for c in left._columns + right._columns):
+        return "long varbytes payload cannot ride fixed word lanes"
+    if getattr(config, "exact", False):
+        for li, rj in zip(config.left_column_idx,
+                          config.right_column_idx):
+            kw = _pair_k(left._columns[li], right._columns[rj])
+            if kw is not None and kw > EXACT_KEY_WORDS:
+                # the shuffle path byte-verifies exact long keys
+                # post-exchange; the broadcast path has no equivalent
+                return "exact long varbytes keys need post-verification"
+    return None
+
+
+def broadcast_hash_join(left: Table, right: Table,
+                        config: _join.JoinConfig,
+                        build_side: int = 1) -> Table:
+    """Replicate ``build_side`` (0=left, 1=right) to every shard and
+    probe locally — the zero-all-to-all join for a measured-small
+    build side. INNER may replicate either side; LEFT only its right
+    input, RIGHT only its left (the probe must cover every row the
+    join can emit unmatched). Ineligible shapes fall back to
+    `distributed_join` (correct, just exchanged), annotating the open
+    span with ``broadcast_fallback``. The output carries the PROBE
+    side's placement witness unchanged: probe rows (and their
+    duplicate expansions) never leave their shard."""
+    ctx = left._ctx
+    world = ctx.get_world_size()
+    if world == 1:
+        # a 1-wide mesh replicates nothing: the local join IS the
+        # broadcast join (reference parity with distributed_join)
+        _counter("cylon_join_algorithm_total", {"algo": "local"}).inc()
+        return _ledger.track(table_mod.join(left, right, config),
+                             "distributed_join")
+    reason = _broadcast_eligible(left, right, config, build_side)
+    if reason is not None:
+        _annotate(join_algorithm="shuffle", broadcast_fallback=reason)
+        return distributed_join(left, right, config)
+
+    left_d = shard.distribute(left, ctx)
+    right_d = shard.distribute(right, ctx)
+    lidx, ridx = config.left_column_idx, config.right_column_idx
+    lcols, rcols = _align_key_columns_dist(ctx, left_d, right_d, lidx,
+                                           ridx)
+    if build_side == 1:
+        a_t, a_cols, b_t, b_cols = left_d, lcols, right_d, rcols
+    else:
+        a_t, a_cols, b_t, b_cols = right_d, rcols, left_d, lcols
+    # the probe is always the a side, so LEFT/RIGHT both lower to the
+    # local LEFT plan (emit unmatched probe rows)
+    jt_local = _join.JoinType.INNER \
+        if config.type == _join.JoinType.INNER else _join.JoinType.LEFT
+
+    abits, akv, aemit, adat, aval, a_lane_slots = _prep_join_side(
+        ctx, a_t, a_cols, b_cols)
+    bbits, bkv, bemit, bdat, bval, b_lane_slots = _prep_join_side(
+        ctx, b_t, b_cols, a_cols)
+
+    seq = ctx.get_next_sequence()
+    _counter("cylon_join_algorithm_total", {"algo": "broadcast"}).inc()
+    with _span("broadcast_join.plan", seq, world=world,
+               rows_in=a_t.capacity + b_t.capacity,
+               build_rows=b_t.capacity, build_bytes=int(b_t.nbytes)):
+        rep_counts, lo, m, bperm, un_mask = _bcast_join_plan_fn(
+            ctx.mesh, jt_local)(abits, akv, aemit, bbits, bkv, bemit)
+        # the gather program is this join's only collective transport
+        _counter("cylon_collective_launches_total").inc()
+        cm = np.asarray(jax.device_get(rep_counts)).reshape(world, 2)
+        _host_sync("join.plan")
+        _annotate(rows_out=int(cm[:, 0].sum()))
+    cap_p = _bucket_cap(int(cm[:, 0].max()))
+
+    with _span("broadcast_join.materialize", seq, world=world,
+               capacity=cap_p):
+        aod, aov, bod, bov, emit = _bcast_join_mat_fn(
+            ctx.mesh, jt_local, cap_p)(lo, m, bperm, un_mask, aemit,
+                                       adat, aval, bdat, bval)
+        _counter("cylon_collective_launches_total").inc()
+
+    a_cols_out = _rebuild_join_side(ctx, aod, aov, a_t, a_lane_slots,
+                                    "a")
+    b_cols_out = _rebuild_join_side(ctx, bod, bov, b_t, b_lane_slots,
+                                    "b")
+    if build_side == 1:
+        cols = a_cols_out + b_cols_out
+        nl = a_t.column_count
+    else:
+        cols = b_cols_out + a_cols_out
+        nl = b_t.column_count
+    cols = [c.rename(f"lt-{i}" if i < nl else f"rt-{i}")
+            for i, c in enumerate(cols)]
+    result = Table(cols, ctx, emit)
+    # probe rows never moved (and duplicate expansions stay on their
+    # source shard), so the probe table's placement witness survives —
+    # position-mapped when the probe is the right side
+    probe_t = left_d if build_side == 1 else right_d
+    sig = probe_t._hash_partitioned
+    if sig is not None:
+        pos, dts, w = sig
+        if build_side == 0:
+            pos = tuple(nl + int(p) for p in pos)
+        result._hash_partitioned = (tuple(int(p) for p in pos),
+                                    tuple(dts), int(w))
+    left._free_if_unretained()
+    right._free_if_unretained()
+    return _ledger.track(result, "distributed_join")
 
 
 # ---------------------------------------------------------------------------

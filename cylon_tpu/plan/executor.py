@@ -276,13 +276,23 @@ class _Exec:
         # warehouse to join against the measured output
         self._est = est or {}
 
-    def _stamp_stats(self, sp, node: ir.PlanNode, out: Table) -> None:
+    def _stamp_stats(self, sp, node: ir.PlanNode, out: Table,
+                     inputs: Optional[Tuple[Table, Table]] = None
+                     ) -> None:
         """Attach the statistics-warehouse feed to a node's span:
         sub-fingerprint, the (calibrated) estimate that was acted on,
         and the measured output size. ``bytes_out`` (Table.nbytes) and
         ``rows_out`` (capacity) are host arithmetic over known shapes
         — no device sync, so the default execute path stays as cheap
-        as before."""
+        as before.
+
+        Two adaptive-execution feeds ride along: the node's worst
+        PRE-MITIGATION exchange skew (folded from its own completed
+        exchange spans, or the ``skew_raw`` attr the salted path
+        annotates — the salting decision must read raw key skew, not
+        its own mitigation), and — for joins, with ``inputs`` — both
+        sides' measured input sizes under the algorithm-invariant
+        decision fingerprint (the broadcast rewrite's evidence base)."""
         from .report import effective_bytes
 
         e = self._est.get(id(node))
@@ -292,6 +302,20 @@ class _Exec:
                est_bytes=effective_bytes(e),
                est_source=e.get("est_source", "static"),
                bytes_out=int(out.nbytes), rows_out=int(out.capacity))
+        skews = [s.attrs.get("skew_imbalance") for s in sp.walk()
+                 if s is not sp]
+        skews.append(sp.attrs.get("skew_raw"))
+        skews = [float(s) for s in skews if s is not None]
+        if skews:
+            sp.set(skew_max=max(skews))
+        if e.get("decision_fp"):
+            # the rewrite-invariant decision key: skew lands under it
+            # for shuffles, per-side input sizes for joins
+            sp.set(stats_decision_fp=e["decision_fp"])
+            if inputs is not None:
+                lt, rt = inputs
+                sp.set(left_in_bytes=int(lt.nbytes),
+                       right_in_bytes=int(rt.nbytes))
 
     def run(self, node: ir.PlanNode) -> Table:
         # node boundaries are the deadline check points: a query past
@@ -370,17 +394,22 @@ class _Exec:
         t = self.run(node.children[0])
         if _world(self.ctx) == 1:
             return t
+        salted = bool(getattr(node, "salted", False))
         # runtime-witness check BEFORE the span: an already-placed input
         # makes this a no-op, which must not count as an exchange stage
+        # (a SALTED shuffle always executes — its job is load balance,
+        # which key placement does not provide under hot keys)
         sig = shard.partition_signature(
             [t._columns[k] for k in node.keys], tuple(node.keys),
             self.ctx.get_world_size())
-        if sig is not None and t._hash_partitioned == sig:
+        if sig is not None and t._hash_partitioned == sig and not salted:
             return t
         with _span("plan.shuffle.explicit", self._seq(),
-                   world=_world(self.ctx), rows_in=t.capacity) as sp:
-            out = _ledger.track(dist_ops.shuffle(t, node.keys),
-                                "plan.shuffle")
+                   world=_world(self.ctx), rows_in=t.capacity,
+                   **({"salted": True} if salted else {})) as sp:
+            out = _ledger.track(
+                dist_ops.shuffle(t, node.keys, salted=salted),
+                "plan.shuffle")
             self._stamp_stats(sp, node, out)
             return out
 
@@ -393,19 +422,29 @@ class _Exec:
         lt = self.run(lsrc)
         rt = self.run(rsrc)
         world = _world(self.ctx)
+        broadcast = world > 1 and node.algorithm == "broadcast" \
+            and getattr(node, "build_side", None) in (0, 1)
         # the label reports what the RUNTIME will do, not what the plan
         # claims: count sides whose witness check will fail inside
-        # distributed_join (markers present or not)
+        # distributed_join (markers present or not). A broadcast join
+        # exchanges NOTHING — the build side rides one gather program
         n_ex = 0
-        if world > 1:
+        if world > 1 and not broadcast:
             n_ex = int(self._side_exchanges(lt, node.left_on, rt,
                                             node.right_on)) \
                 + int(self._side_exchanges(rt, node.right_on, lt,
                                            node.left_on))
         label = "plan.shuffle.join" if n_ex else "plan.join"
+        algo = "broadcast" if broadcast \
+            else ("shuffle" if world > 1 else "local")
+        # an un-rewritten "broadcast" request (world 1, knob =shuffle,
+        # no build side picked) lowers with the default local hint —
+        # "broadcast" is not a local-kernel algorithm
+        local_alg = "auto" if node.algorithm == "broadcast" \
+            else node.algorithm
         blk = self._degrade.get(id(node))
         with _span(label, self._seq(), world=world, how=node.how,
-                   sides_exchanged=n_ex,
+                   sides_exchanged=n_ex, join_algorithm=algo,
                    rows_in=lt.capacity + rt.capacity) as sp:
             if blk:
                 # admission-controller degrade: the blocked/chunked
@@ -415,19 +454,33 @@ class _Exec:
                 # anyway — this is that path with an explicit block)
                 sp.set(mode="blocked", probe_block_rows=int(blk))
                 out = _ledger.track(
-                    lt.join(rt, node.how, node.algorithm,
+                    lt.join(rt, node.how, local_alg,
                             left_on=list(node.left_on),
                             right_on=list(node.right_on),
                             probe_block_rows=int(blk)),
                     "plan.join")
+            elif broadcast:
+                # adaptive rewrite (or forced knob): replicate the
+                # build side, probe locally — the local-kernel
+                # algorithm hint stays "auto". An ineligible shape
+                # (long varbytes) falls back inside
+                # broadcast_hash_join, which re-annotates the span
+                out = _ledger.track(
+                    lt.distributed_join(
+                        rt, node.how, "auto",
+                        left_on=list(node.left_on),
+                        right_on=list(node.right_on),
+                        comm="broadcast",
+                        build_side=int(node.build_side)),
+                    "plan.join")
             else:
                 out = _ledger.track(
                     lt.distributed_join(
-                        rt, node.how, node.algorithm,
+                        rt, node.how, local_alg,
                         left_on=list(node.left_on),
                         right_on=list(node.right_on)),
                     "plan.join")
-            self._stamp_stats(sp, node, out)
+            self._stamp_stats(sp, node, out, inputs=(lt, rt))
             return out
 
     def _do_groupby(self, node: ir.GroupBy) -> Table:

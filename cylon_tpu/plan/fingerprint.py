@@ -58,8 +58,12 @@ def _expr_tokens(e) -> tuple:
     return ("expr", repr(e))  # future Expr kinds: repr is still stable
 
 
-def node_tokens(n: ir.PlanNode) -> tuple:
-    """Canonical token tree for one plan node + its subtree."""
+def _own_tokens(n: ir.PlanNode, with_algorithm: bool = True) -> tuple:
+    """One node's own token prefix (kind, schema, types, extras) —
+    children excluded. ``with_algorithm=False`` drops the Join
+    algorithm token (the decision-fingerprint normalization: the
+    measured history of a join must survive its own rewrite, or the
+    adaptive loop could never self-correct a mis-learned choice)."""
     if isinstance(n, ir.Scan):
         sig = n.witness_sig
         wit = None if sig is None else (
@@ -71,10 +75,15 @@ def node_tokens(n: ir.PlanNode) -> tuple:
     elif isinstance(n, ir.Filter):
         extra = ("expr", _expr_tokens(n.expr))
     elif isinstance(n, ir.Shuffle):
+        # NB: the `salted` flag is deliberately NOT a token — a salted
+        # and an unsalted exchange of the same shape share one measured
+        # history, so the salting decision reads pre-mitigation skew
+        # (the exchange records the RAW count matrix) and never flaps
         extra = ("keys", tuple(n.keys))
     elif isinstance(n, ir.Join):
         extra = ("on", tuple(n.left_on), tuple(n.right_on),
-                 str(n.how), str(n.algorithm))
+                 str(n.how)) + \
+            ((str(n.algorithm),) if with_algorithm else ())
     elif isinstance(n, ir.GroupBy):
         extra = ("agg", tuple(n.keys), tuple(n.agg_cols), tuple(n.ops))
     elif isinstance(n, ir.SetOp):
@@ -87,8 +96,30 @@ def node_tokens(n: ir.PlanNode) -> tuple:
     # EXPLAIN/report renders and admission worst-node forensics, so a
     # plan-cache hit must guarantee the cached template's names are the
     # query's own — two shapes that differ only in names get two entries
-    return (n.kind, tuple(n.schema), tuple(n.types)) + extra + \
-        tuple(node_tokens(c) for c in n.children)
+    return (n.kind, tuple(n.schema), tuple(n.types)) + extra
+
+
+def node_tokens(n: ir.PlanNode) -> tuple:
+    """Canonical token tree for one plan node + its subtree."""
+    return _own_tokens(n) + tuple(node_tokens(c) for c in n.children)
+
+
+def _decision_tokens(n: ir.PlanNode) -> tuple:
+    """Algorithm-invariant token tree: join-side Shuffle markers are
+    stripped and the Join algorithm token dropped, so a shuffle join,
+    its physical plan with inserted exchanges, and its broadcast
+    rewrite all produce the SAME tokens. This is what keys the
+    warehouse's per-join input-size history (``join_input`` entries):
+    the first (exploratory, shuffle) run and every later broadcast run
+    feed one entry, which is what lets a mis-learned broadcast drift,
+    evict and revert instead of replaying its own stale evidence."""
+    kids = n.children
+    if isinstance(n, ir.Join):
+        kids = [c.children[0] if isinstance(c, ir.Shuffle) else c
+                for c in kids]
+        return _own_tokens(n, with_algorithm=False) + \
+            tuple(_decision_tokens(c) for c in kids)
+    return _own_tokens(n) + tuple(_decision_tokens(c) for c in kids)
 
 
 def fingerprint(root: ir.PlanNode, world: int) -> str:
@@ -108,4 +139,35 @@ def node_fingerprint(node: ir.PlanNode, world: int) -> str:
     appearing in two different plans shares one measured history —
     cross-plan learning for free."""
     doc = ("cylon-node-fp", FP_VERSION, int(world), node_tokens(node))
+    return hashlib.sha256(repr(doc).encode("utf-8")).hexdigest()
+
+
+def shuffle_decision_fingerprint(node: ir.PlanNode, world: int) -> str:
+    """Stable hex fingerprint of a standalone Shuffle's DECISION shape
+    (same ``_decision_tokens`` normalization as joins: join-side
+    exchange markers below it stripped, algorithm tokens dropped) —
+    the key of the warehouse's measured exchange-skew history. Plain
+    ``node_fingerprint`` would fork the key space across the
+    optimizer's own rewrites: the executed (post-elide, possibly
+    broadcast-rewritten) subtree tokens differ from the pre-elide tree
+    the salting decision inspects, and the skew evidence would land
+    where the decision never looks."""
+    doc = ("cylon-shuffle-decision-fp", FP_VERSION, int(world),
+           _decision_tokens(node))
+    return hashlib.sha256(repr(doc).encode("utf-8")).hexdigest()
+
+
+def join_decision_fingerprint(node: ir.PlanNode, world: int) -> str:
+    """Stable hex fingerprint of a Join's DECISION shape — algorithm
+    token dropped and join-side exchange markers stripped (recursively,
+    see ``_decision_tokens``) — under a given world size. The key of
+    the warehouse's measured per-side input sizes (``join_input``
+    entries): identical for the logical plan, the shuffle-inserted
+    physical plan, and the broadcast rewrite, so the adaptive
+    optimizer's evidence base is fed by every execution regardless of
+    which algorithm actually ran. A third disjoint document prefix
+    keeps this key space from ever colliding with plan- or node-level
+    fingerprints."""
+    doc = ("cylon-join-decision-fp", FP_VERSION, int(world),
+           _decision_tokens(node))
     return hashlib.sha256(repr(doc).encode("utf-8")).hexdigest()

@@ -260,19 +260,35 @@ class Filter(PlanNode):
 class Shuffle(PlanNode):
     """Explicit hash repartition by key columns — inserted by the
     physical-planning pass below joins (and by user `.shuffle()`), then
-    deleted by the elision pass when its input already satisfies it."""
+    deleted by the elision pass when its input already satisfies it.
+
+    ``salted``: set by the adaptive pass (optimizer.adapt_from_stats)
+    on STANDALONE shuffles whose measured skew crossed the warning
+    threshold — the exchange spreads each hot destination's rows
+    across ``CYLON_SALT_FACTOR`` sub-buckets, so the output is
+    load-balanced but carries NO hash-placement witness (the salt is
+    positional; downstream consumers re-establish placement)."""
 
     kind = "shuffle"
 
     def __init__(self, child: PlanNode, keys: Sequence[int]):
         super().__init__([child], child.schema, child.types)
         self.keys = [int(k) for k in keys]
+        self.salted = False
 
     def args_repr(self):
-        return f"keys={self.keys}"
+        return f"keys={self.keys}" + (", salted" if self.salted else "")
 
 
 class Join(PlanNode):
+    """``algorithm`` is the user-facing local-kernel hint ("auto" /
+    "sort" / "hash") — or "broadcast", the adaptive rewrite
+    (optimizer.adapt_from_stats): the ``build_side`` (0=left, 1=right)
+    is replicated to every shard inside one gather program and probed
+    locally, with NO all-to-all on either side. ``build_side`` is set
+    only by the rewrite; a user-forced ``algorithm="broadcast"`` leaves
+    it None until the optimizer picks the side."""
+
     kind = "join"
 
     def __init__(self, left: PlanNode, right: PlanNode,
@@ -286,9 +302,14 @@ class Join(PlanNode):
         self.right_on = [int(j) for j in right_on]
         self.how = how
         self.algorithm = algorithm
+        self.build_side: Optional[int] = None
 
     def args_repr(self):
-        return f"{self.how}, l{self.left_on}=r{self.right_on}"
+        alg = f", algo={self.algorithm}" \
+            if self.algorithm not in ("auto",) else ""
+        bs = f", build={self.build_side}" \
+            if self.build_side is not None else ""
+        return f"{self.how}, l{self.left_on}=r{self.right_on}{alg}{bs}"
 
 
 class GroupBy(PlanNode):

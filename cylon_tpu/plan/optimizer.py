@@ -27,6 +27,16 @@ Pass order is load-bearing:
    through string keys or dtype-promoting joins — exactly the cases
    where the runtime witness (`shard.partition_signature`) is also
    None, so plan-time claims and run-time skips cannot diverge.
+5. ``adapt_from_stats`` — the cost-based adaptive pass (ROADMAP item
+   1), running BETWEEN pruning and elision: measured build-side sizes
+   from the statistics warehouse rewrite eligible joins to
+   ``algorithm="broadcast"`` (replicate the small side, drop BOTH
+   exchanges), and measured skew sets ``salted=True`` on standalone
+   shuffles. It must precede ``elide_shuffles`` because the rewrite
+   CHANGES a join's output witness (probe placement, not join keys):
+   elision claims derived from the pre-rewrite witnesses would be
+   false plan claims the verifier rejects. See the section comment
+   below.
 """
 from __future__ import annotations
 
@@ -44,14 +54,20 @@ class PlanStats:
     groupbys_localized: int = 0
     filters_pushed: int = 0
     columns_pruned: int = 0
+    joins_broadcast: int = 0
+    shuffles_salted: int = 0
     notes: list = field(default_factory=list)
 
     def summary(self) -> str:
+        adaptive = ""
+        if self.joins_broadcast or self.shuffles_salted:
+            adaptive = (f"; joins broadcast: {self.joins_broadcast}; "
+                        f"exchanges salted: {self.shuffles_salted}")
         return (f"shuffles: {self.shuffles_inserted} planned, "
                 f"{self.shuffles_elided} elided; "
                 f"groupbys localized: {self.groupbys_localized}; "
                 f"filters pushed below shuffle: {self.filters_pushed}; "
-                f"columns pruned: {self.columns_pruned}")
+                f"columns pruned: {self.columns_pruned}" + adaptive)
 
 
 # ---------------------------------------------------------------------------
@@ -217,8 +233,21 @@ def _propagate(node: ir.PlanNode, world: int) -> Optional[Tuple[int, ...]]:
     elif isinstance(node, ir.Filter):
         pb = pbs[0]
     elif isinstance(node, ir.Shuffle):
-        if _hashable_keys(node, node.keys):
+        # a salted exchange spreads hot keys positionally — its output
+        # is load-balanced, never hash-placed (mirror of the runtime:
+        # dist_ops.shuffle withholds the witness on the salted path)
+        if not node.salted and _hashable_keys(node, node.keys):
             pb = tuple(node.keys)
+    elif isinstance(node, ir.Join) and node.algorithm == "broadcast" \
+            and node.build_side in (0, 1):
+        # broadcast join: probe rows never move, so the PROBE side's
+        # placement survives unchanged (mirror of verify.derive_witness
+        # and of the runtime witness broadcast_hash_join preserves)
+        probe = 1 - node.build_side
+        cpb = pbs[probe]
+        if cpb is not None:
+            nl = node.children[0].width
+            pb = cpb if probe == 0 else tuple(nl + p for p in cpb)
     elif isinstance(node, ir.Join):
         l, r = node.children
         # dtype-equal key pairs only: a promoting alignment hashes the
@@ -287,6 +316,241 @@ def elide_shuffles(root: ir.PlanNode, world: int,
     return root
 
 
+# ---------------------------------------------------------------------------
+# the adaptive pass: adaptive join execution (ROADMAP item 1 — the first pass whose
+# output CHANGES SHAPE based on runtime feedback). Consults the
+# statistics warehouse (telemetry/stats.py), never raw tables:
+#
+# * a Join whose measured build-side input (EWMA x CYLON_STATS_SAFETY,
+#   keyed by the algorithm-invariant join_decision_fingerprint) fits
+#   under CYLON_BROADCAST_MAX_BYTES — with the probe side measured at
+#   least BROADCAST_MIN_RATIO x larger — rewrites to
+#   Join(algorithm="broadcast", build_side=s) and DROPS both side
+#   exchanges: the build side is replicated inside one gather program
+#   and probed locally, zero all-to-all (dist_ops.broadcast_hash_join).
+# * a STANDALONE Shuffle whose measured skew (pre-mitigation imbalance
+#   factor) crossed CYLON_SKEW_WARN_FACTOR sets salted=True: the
+#   exchange spreads each hot destination across CYLON_SALT_FACTOR
+#   sub-buckets, bounding the max shard under Zipfian keys (at the
+#   price of the placement witness, which _propagate then withholds).
+#
+# First execution of a shape finds no qualified statistics and stays
+# shuffle (exploratory); CYLON_JOIN_ALGORITHM=shuffle disables every
+# adaptive rewrite (the exact pre-adaptive program — broadcast kernel
+# factories are never built), =broadcast forces the rewrite on every
+# eligible shape. Soundness is not stats-dependent: replication is
+# always correct, the witness verifier (plan/verify.py) checks every
+# broadcast CLAIM structurally, and a mis-learned choice self-corrects
+# — the first broadcast run measures the true input sizes under the
+# SAME decision fingerprint, drift fires, the plan-cache entry evicts,
+# and the shape reverts to shuffle until re-learned.
+# ---------------------------------------------------------------------------
+
+# sides eligible to be the replicated BUILD side, per join type (in
+# PREFERENCE order — inner defaults to building right): the probe
+# side's rows must cover every row the join emits (unmatched-side
+# emission needs the full table resident, which only the probe is).
+# One of three deliberately-independent copies (verifier + runtime
+# hold the others; layering forbids sharing) — agreement pinned by
+# tests/test_adaptive_join.py::test_broadcast_side_tables_agree
+_BROADCAST_SIDES = {"inner": (1, 0), "left": (1,), "right": (0,)}
+
+# beyond the byte budget, broadcast must also promise an exchange win:
+# the probe side must measure at least this many times the build side,
+# or two same-sized small tables would flap between algorithms for no
+# benefit (and perturb warmed-cache pipelines mid-stream)
+BROADCAST_MIN_RATIO = 4.0
+
+
+def _stats_store():
+    from ..telemetry import stats as _stats
+
+    return _stats
+
+
+def join_algorithm_mode() -> str:
+    mode = _knobs.get("CYLON_JOIN_ALGORITHM")
+    return mode if mode in ("auto", "shuffle", "broadcast") else "auto"
+
+
+def broadcast_choice(node: ir.PlanNode, world: int) -> Optional[int]:
+    """The build side (0|1) a broadcast rewrite would pick for one
+    Join, or None — a pure function of (join shape, knobs, warehouse),
+    shared by the rewrite pass and the plan cache's staleness check.
+    An already-rewritten template (algorithm "broadcast" WITH a build
+    side) re-decides from the live statistics, so a post-drift check
+    sees the choice revert."""
+    if world <= 1 or not isinstance(node, ir.Join):
+        return None
+    mode = join_algorithm_mode()
+    if mode == "shuffle":
+        return None
+    sides = _BROADCAST_SIDES.get(node.how)
+    if not sides:
+        return None
+    user_forced = node.algorithm == "broadcast" and \
+        node.build_side is None
+    if node.algorithm not in ("auto", "broadcast"):
+        return None  # user pinned a local algorithm; leave it alone
+    st = _stats_store()
+    fp = None
+    lb = rb = None
+    limit = int(_knobs.get("CYLON_BROADCAST_MAX_BYTES"))
+    if limit > 0:
+        from .fingerprint import join_decision_fingerprint
+
+        fp = join_decision_fingerprint(node, world)
+        lb, rb = st.join_input_bytes(fp)
+    if mode == "broadcast" or user_forced:
+        # forced: measured sizes only break the tie between two
+        # eligible sides; no statistics are required
+        if len(sides) == 2 and lb is not None and rb is not None:
+            return 0 if lb <= rb else 1
+        return sides[0]
+    if limit <= 0:
+        return None
+    best = None
+    for s in sides:
+        build, probe = (lb, rb) if s == 0 else (rb, lb)
+        if build is None or probe is None:
+            continue
+        if build * st.safety() <= limit \
+                and probe >= BROADCAST_MIN_RATIO * build \
+                and (best is None or build < best[1]):
+            best = (s, build)
+    return best[0] if best is not None else None
+
+
+def salt_choice(node: ir.PlanNode, world: int) -> bool:
+    """Whether a standalone Shuffle's measured skew justifies hot-key
+    salting — pure function of (shape, knobs, warehouse), shared with
+    the plan cache's staleness check. Keyed by the rewrite-invariant
+    ``shuffle_decision_fingerprint`` (the SAME normalization the
+    executor stamps skew under), so elision or broadcast rewrites
+    below the shuffle never fork the evidence away from the lookup."""
+    if world <= 1 or not isinstance(node, ir.Shuffle):
+        return False
+    if int(_knobs.get("CYLON_SALT_FACTOR")) < 2:
+        return False
+    if join_algorithm_mode() == "shuffle":
+        return False  # the "exact pre-adaptive program" escape hatch
+    from .fingerprint import shuffle_decision_fingerprint
+
+    skew = _stats_store().node_skew(
+        shuffle_decision_fingerprint(node, world))
+    return skew is not None and \
+        skew >= float(_knobs.get("CYLON_SKEW_WARN_FACTOR"))
+
+
+def adaptive_knobs() -> tuple:
+    """EVERY knob the two decisions read — part of every cached
+    decision vector, so a flipped knob can never replay a stale
+    algorithm choice out of the plan cache (CYLON_STATS_SAFETY and
+    CYLON_STATS_MIN_OBS gate broadcast_choice through the warehouse
+    reads, so they belong here just as much as the headline knobs)."""
+    st = _stats_store()
+    return (join_algorithm_mode(),
+            int(_knobs.get("CYLON_BROADCAST_MAX_BYTES")),
+            int(_knobs.get("CYLON_SALT_FACTOR")),
+            float(_knobs.get("CYLON_SKEW_WARN_FACTOR")),
+            float(st.safety()), int(st.min_obs()))
+
+
+def decision_vector(root: ir.PlanNode, world: int) -> tuple:
+    """Every adaptive decision this plan's shape resolves to under the
+    CURRENT warehouse + knobs, in walk order. Stable across the
+    rewrite itself (decision fingerprints are algorithm-invariant), so
+    the plan cache can compare the vector recorded at insert time with
+    a fresh one to decide whether a template's algorithm choices are
+    stale (service/plancache.py). Join-side Shuffle markers are
+    EXCLUDED, mirroring adapt_from_stats' applicability — they can
+    never salt, so a cross-plan skew qualification on a shared shape
+    must not evict templates it could not change."""
+    vec = [("knobs",) + adaptive_knobs()]
+
+    def visit(n: ir.PlanNode, parent) -> None:
+        if isinstance(n, ir.Join):
+            vec.append(("join", broadcast_choice(n, world)))
+        elif isinstance(n, ir.Shuffle) and \
+                not isinstance(parent, ir.Join):
+            vec.append(("shuffle", salt_choice(n, world)))
+        for c in n.children:
+            visit(c, n)
+
+    visit(root, None)
+    return tuple(vec)
+
+
+def _would_elide(node: ir.Join, side: int) -> bool:
+    """Mirror of elide_shuffles' join-side deletion condition (on the
+    already-propagated tree): this side's exchange is free, so a
+    broadcast rewrite would trade nothing for a gather."""
+    c = node.children[side]
+    if not isinstance(c, ir.Shuffle):
+        return True  # no marker: the side pays no exchange
+    l, r = node.children
+    pair_dtypes_ok = all(l.types[li] == r.types[rj]
+                         for li, rj in zip(node.left_on, node.right_on))
+    cpb = c.children[0].partitioned_by
+    return pair_dtypes_ok and cpb is not None and cpb == tuple(c.keys)
+
+
+def adapt_from_stats(root: ir.PlanNode, world: int,
+                     stats: PlanStats) -> ir.PlanNode:
+    # runs BEFORE elide_shuffles (pass order is load-bearing): the
+    # broadcast rewrite CHANGES a join's output witness (probe-side
+    # placement instead of join-key placement), so every elision /
+    # local_ok claim must be derived against the post-rewrite tree —
+    # the witness verifier rejects the other order. Propagate first so
+    # the would-elide guard below sees the same metadata elision will.
+    _propagate(root, world)
+
+    def rewrite(node: ir.PlanNode, parent) -> None:
+        for c in node.children:
+            rewrite(c, node)
+        if isinstance(node, ir.Join) and world > 1:
+            side = broadcast_choice(node, world)
+            forced = join_algorithm_mode() == "broadcast" or \
+                node.algorithm == "broadcast"
+            # auto rewrites only fire when the join still PAYS an
+            # exchange on EITHER side: broadcast elides both, so a
+            # free build side with a paying probe is exactly the case
+            # that saves the most (the probe's all-to-all), and only
+            # a fully co-partitioned join — both sides elision-free —
+            # would trade nothing for a gather
+            if side is not None and \
+                    (forced or not (_would_elide(node, side)
+                                    and _would_elide(node, 1 - side))):
+                node.algorithm = "broadcast"
+                node.build_side = side
+                for s in (0, 1):
+                    c = node.children[s]
+                    if isinstance(c, ir.Shuffle):
+                        node.children[s] = c.children[0]
+                # refresh this subtree's metadata so an ENCLOSING
+                # join's would-elide check reads the broadcast
+                # witness, not the stale shuffle-join one
+                _propagate(node, world)
+                stats.joins_broadcast += 1
+                stats.notes.append(
+                    f"join({node.how}) -> broadcast build_side={side} "
+                    f"(measured build fits "
+                    f"CYLON_BROADCAST_MAX_BYTES)")
+        elif isinstance(node, ir.Shuffle) and \
+                not isinstance(parent, ir.Join):
+            # join-side markers need exact placement; only standalone
+            # (load-balancing) exchanges may salt
+            if salt_choice(node, world):
+                node.salted = True
+                stats.shuffles_salted += 1
+                stats.notes.append(
+                    f"shuffle(keys={node.keys}) salted (measured skew "
+                    f">= CYLON_SKEW_WARN_FACTOR)")
+
+    rewrite(root, None)
+    return root
+
+
 def optimize(root: ir.PlanNode, world: int
              ) -> Tuple[ir.PlanNode, PlanStats]:
     """Run all passes; returns the optimized plan and its stats.
@@ -300,6 +564,11 @@ def optimize(root: ir.PlanNode, world: int
     root = insert_shuffles(root, world, stats)
     root = pushdown_filters(root, stats)
     root = prune_projections(root, stats)
+    # adapt BEFORE elide: elision claims (deleted join-side markers,
+    # GroupBy.local_ok) must be justified against the witnesses the
+    # REWRITTEN tree actually provides — a broadcast join's output
+    # carries the probe side's placement, not the join keys'
+    root = adapt_from_stats(root, world, stats)
     root = elide_shuffles(root, world, stats)
     if _knobs.get("CYLON_TPU_VERIFY_PLANS"):
         from .verify import check_plan

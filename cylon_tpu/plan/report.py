@@ -160,7 +160,9 @@ def calibrate_estimates(root: ir.PlanNode, est: Dict[int, dict],
     ``_preflight`` — never double-apply."""
     from ..telemetry import stats as _stats
 
-    from .fingerprint import STATS_NODE_KINDS, node_fingerprint
+    from .fingerprint import (STATS_NODE_KINDS, join_decision_fingerprint,
+                              node_fingerprint,
+                              shuffle_decision_fingerprint)
 
     for node in ir.walk(root):
         if node.kind not in STATS_NODE_KINDS:
@@ -171,6 +173,17 @@ def calibrate_estimates(root: ir.PlanNode, est: Dict[int, dict],
         fp = node_fingerprint(node, world)
         e["node_fp"] = fp
         e["est_source"] = "static"
+        if node.kind == "join":
+            # the algorithm-invariant key the adaptive-join decision
+            # reads: the executor stamps it (with both sides' measured
+            # input sizes) onto the join's span, feeding the broadcast
+            # rewrite's evidence base regardless of which algorithm ran
+            e["decision_fp"] = join_decision_fingerprint(node, world)
+        elif node.kind == "shuffle":
+            # same normalization for the salting decision's skew key:
+            # stable across elision and the broadcast rewrite, so the
+            # evidence lands where salt_choice looks
+            e["decision_fp"] = shuffle_decision_fingerprint(node, world)
         eff, source = _stats.effective_bytes(fp, e.get("bytes"))
         if source == "measured":
             e["calibrated_bytes"] = eff
@@ -215,6 +228,13 @@ class NodeMeasure:
     partition_path: Optional[str] = None  # partition path of this
     #                                node's own exchanges ("pallas" |
     #                                "sort" | "mixed" when they differ)
+    join_algorithm: Optional[str] = None  # the algorithm the join's
+    #                                lowering actually ran ("broadcast"
+    #                                | "shuffle" | "local") — the span
+    #                                attr the adaptive pass's choice
+    #                                lands as
+    salted: bool = False           # this node's exchange ran the
+    #                                hot-key salted (sub-bucketed) path
 
     @property
     def shuffles(self) -> int:
@@ -240,9 +260,13 @@ class NodeMeasure:
         rt = f"  [RETRY×{self.retries}]" if self.retries else ""
         part = f", part={self.partition_path}" \
             if self.partition_path is not None else ""
+        algo = f", algo={self.join_algorithm}" \
+            if self.join_algorithm is not None else ""
+        salt = ", salted" if self.salted else ""
         return (f"{self.desc}{pb}  (actual time={self.ms:.2f} ms, "
                 f"rows={self.rows}, bytes={_human_bytes(self.bytes)}"
-                f"{est}, shuffles={self.shuffles}{part}{sk}){mem}{rt}")
+                f"{est}, shuffles={self.shuffles}{algo}{salt}{part}"
+                f"{sk}){mem}{rt}")
 
     def to_dict(self) -> dict:
         return {
@@ -258,6 +282,8 @@ class NodeMeasure:
             "mem_warn": self.mem_warn,
             "retries": self.retries,
             "partition_path": self.partition_path,
+            "join_algorithm": self.join_algorithm,
+            "salted": self.salted,
             "shuffles": self.shuffles, "labels": list(self.labels),
             "skew": dict(self.skew) if self.skew is not None else None,
             "children": [c.to_dict() for c in self.children],
@@ -347,6 +373,8 @@ def build_measures(node: ir.PlanNode, recs: Dict[int, object],
     skew = None
     retries = 0
     part = None
+    algo = None
+    salted = False
     if spans is not None:
         ex_spans = [spans[i] for i in own_idx
                     if spans[i].name.startswith("shuffle.exchange")]
@@ -356,9 +384,16 @@ def build_measures(node: ir.PlanNode, recs: Dict[int, object],
         # retry loop) — fold them so the node renders [RETRY×n]
         retries = sum(int(spans[i].attrs.get("retries", 0))
                       for i in own_idx)
+        for i in own_idx:
+            a = getattr(spans[i], "attrs", {})
+            if algo is None and a.get("join_algorithm") is not None:
+                algo = str(a["join_algorithm"])
+            if a.get("salted"):
+                salted = True
     return NodeMeasure(executed=True, ms=r.ms, rows=r.rows,
                        bytes=r.nbytes, labels=own, skew=skew,
-                       retries=retries, partition_path=part, **base)
+                       retries=retries, partition_path=part,
+                       join_algorithm=algo, salted=salted, **base)
 
 
 @dataclass

@@ -80,10 +80,32 @@ def derive_witness(node: ir.PlanNode, world: int) -> Optional[Witness]:
         return child[0]  # dropping rows never moves the survivors
 
     if isinstance(node, ir.Shuffle):
+        if node.salted:
+            # a salted exchange spreads hot keys across sub-buckets:
+            # placement is positional, never a hash witness
+            return None
         if not _hashable(node.types, node.keys):
             return None
         pos = tuple(node.keys)
         return pos, tuple(node.types[k] for k in pos)
+
+    if isinstance(node, ir.Join) and node.algorithm == "broadcast":
+        # a SOUND broadcast join never moves probe rows (the build side
+        # is replicated to every shard), so the probe side's witness
+        # survives position-mapped through the output schema; an
+        # unsound claim yields no witness at all (and verify_plan
+        # rejects the plan outright)
+        if world <= 1 or broadcast_claim_reason(node) is not None:
+            return None
+        probe = 1 - node.build_side
+        w = child[probe]
+        if w is None:
+            return None
+        pos, dts = w
+        if probe == 1:
+            nl = node.children[0].width
+            pos = tuple(nl + p for p in pos)
+        return pos, dts
 
     if isinstance(node, ir.Join):
         if world <= 1:
@@ -116,6 +138,35 @@ def derive_witness(node: ir.PlanNode, world: int) -> Optional[Witness]:
 
     # SetOp: output carries no runtime witness; Sort: range-, not
     # hash-partitioned
+    return None
+
+
+# sides whose replication is a valid justification per join type: the
+# probe side must cover every row the join can emit unmatched, so a
+# LEFT join may only replicate its RIGHT input (and vice versa) — a
+# replicated side's unmatched rows would be emitted once PER SHARD.
+# One of three deliberately-independent copies (the optimizer's choice
+# table and dist_ops' runtime gate hold the others; this one stays
+# optimizer-independent by design) — agreement pinned by
+# tests/test_adaptive_join.py::test_broadcast_side_tables_agree
+_BROADCAST_SIDES = {"inner": (0, 1), "left": (1,), "right": (0,)}
+
+
+def broadcast_claim_reason(node: ir.Join) -> Optional[str]:
+    """None when a Join's ``algorithm="broadcast"`` claim carries a
+    sound replication witness — a declared build side the runtime may
+    legally replicate under this join type. The broadcast lowering
+    (dist_ops.broadcast_hash_join) replicates exactly that side, so a
+    valid claim justifies BOTH inputs reaching the join unexchanged;
+    an invalid one (no build side, or a side whose unmatched rows the
+    join must emit) is rejected outright — a mis-learned rewrite can
+    degrade performance but never soundness."""
+    bs = node.build_side
+    legal = _BROADCAST_SIDES.get(node.how, ())
+    if bs not in legal:
+        return (f"broadcast join lacks a replication witness: "
+                f"build_side={bs!r} is not replicable under "
+                f"how={node.how!r} (legal: {legal or 'none'})")
     return None
 
 
@@ -152,7 +203,15 @@ def verify_plan(root: ir.PlanNode, world: int) -> List[str]:
 
     def visit(node: ir.PlanNode, path: str):
         here = f"{path}/{type(node).__name__}"
-        if isinstance(node, ir.Join) and world > 1:
+        if isinstance(node, ir.Join) and world > 1 and \
+                node.algorithm == "broadcast":
+            reason = broadcast_claim_reason(node)
+            if reason is not None:
+                problems.append(f"{here}: {reason}")
+            # a sound claim justifies both unexchanged inputs: the
+            # runtime replicates the declared build side, so every
+            # probe row sees the full build table locally
+        elif isinstance(node, ir.Join) and world > 1:
             for label, side, keys, other, okeys in (
                     ("left", node.children[0], node.left_on,
                      node.children[1], node.right_on),

@@ -37,6 +37,16 @@ hand-poisoned (or future-bug-corrupted) entry is rejected with a typed
 :class:`CylonPlanError` — and evicted — instead of silently executing
 an unsound elision.
 
+Adaptive staleness (PR 15): each entry records the statistics-warehouse
+EPOCH and the optimizer's adaptive DECISION VECTOR (broadcast/salt
+choices, plan/optimizer.decision_vector) it was optimized under. A hit
+whose epoch moved re-checks the vector against the live warehouse:
+unchanged decisions refresh the entry (still a hit); changed ones —
+a drift event, a newly-qualified build side, a flipped knob — evict
+and re-optimize (``cylon_plan_cache_stale_total``), so a cached
+template can never replay an algorithm choice its evidence no longer
+supports.
+
 Metrics: ``cylon_plan_cache_{hits,misses,evictions}_total``. Because a
 hit re-fires the same lowerings, the same ``counted_cache`` kernel
 factories re-hit their memo — the PR-4 profiler's
@@ -66,10 +76,12 @@ from ..plan import ir
 # service tier); re-exported here unchanged — this module remains the
 # semantics owner of what the key covers (docstring above)
 from ..plan.fingerprint import FP_VERSION, fingerprint  # noqa: F401
-from ..plan.optimizer import PlanStats, optimize as _optimize
+from ..plan.optimizer import PlanStats, adaptive_knobs as _adaptive_knobs, \
+    decision_vector as _decision_vector, optimize as _optimize
 from ..plan.verify import check_plan as _check_plan
 from ..telemetry import knobs as _knobs
 from ..telemetry import metrics as _metrics
+from ..telemetry import spans as _spans
 from ..telemetry import stats as _stats
 
 DEFAULT_CACHE_MAX = _knobs.default("CYLON_PLAN_CACHE_MAX")
@@ -134,7 +146,7 @@ class PlanCache:
             hit = self._entries.get(fp)
             if hit is not None:
                 self._entries.move_to_end(fp)
-        if hit is not None:
+        if hit is not None and self._fresh(fp, hit, world):
             out = self._rebind(fp, hit, root, world)
             if out is not None:
                 self._counter("hits").inc()
@@ -147,13 +159,58 @@ class PlanCache:
         self._counter("misses").inc()
         _set_last_event(fp, "miss")
         opt_root, stats = _optimize(root, world)
+        # the template records the statistics EPOCH and the adaptive
+        # decision vector it was optimized under — the staleness
+        # signal (_fresh) that keeps a cached algorithm choice from
+        # outliving its evidence
+        epoch = _stats.epoch()
+        vec = _decision_vector(opt_root, world)
         with self._lock:
-            self._entries[fp] = (_strip_template(opt_root), stats)
+            self._entries[fp] = (_strip_template(opt_root), stats,
+                                 epoch, vec)
             self._entries.move_to_end(fp)
             while len(self._entries) > cap:
                 self._entries.popitem(last=False)
                 self._counter("evictions").inc()
         return opt_root, stats
+
+    def _fresh(self, fp: str, entry: tuple, world: int) -> bool:
+        """Is a cached template's ADAPTIVE shape still what the
+        warehouse would decide today? Fast path: the stats epoch (and
+        the adaptive knobs) have not moved since the template was
+        optimized — hit without recomputing anything. Otherwise
+        recompute the decision vector over the template (decision
+        fingerprints are algorithm-invariant, so the rewritten
+        template resolves identically to the pre-rewrite tree): equal
+        means the epoch bump concerned OTHER shapes — refresh the
+        entry's epoch and hit; different means this template's
+        algorithm choices are stale — evict, miss, re-optimize. A
+        drift event therefore re-optimizes instead of replaying the
+        stale choice, and a newly-qualified build side flips a warmed
+        shape to broadcast without waiting for an LRU eviction."""
+        tmpl, stats, epoch, vec = entry
+        now_epoch = _stats.epoch()
+        knobs_now = ("knobs",) + _adaptive_knobs()
+        if epoch == now_epoch and vec and vec[0] == knobs_now:
+            return True
+        try:
+            vec_now = _decision_vector(tmpl, world)
+        except Exception:  # pragma: no cover - defensive
+            _spans.logger.exception(
+                "plan-cache staleness check failed for %s — evicting",
+                fp[:12])
+            self.invalidate(fp)
+            self._counter("stale").inc()
+            return False
+        if vec_now == vec:
+            with self._lock:
+                cur = self._entries.get(fp)
+                if cur is not None and cur[0] is tmpl:
+                    self._entries[fp] = (tmpl, stats, now_epoch, vec)
+            return True
+        self.invalidate(fp)
+        self._counter("stale").inc()
+        return False
 
     def invalidate(self, fp: str) -> bool:
         """Drop one entry; True when something was actually removed."""
@@ -166,7 +223,7 @@ class PlanCache:
         rebind scan tables in walk order, and (in debug mode) re-run
         the witness verifier so a poisoned entry is rejected — evicted
         and raised as :class:`CylonPlanError` — never executed."""
-        tmpl, stats = entry
+        tmpl, stats = entry[0], entry[1]
         plan = copy.deepcopy(tmpl)
         dst, src = _scans(plan), _scans(root)
         if len(dst) != len(src):

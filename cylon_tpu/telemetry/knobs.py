@@ -277,6 +277,28 @@ declare("CYLON_STATS_PATH", None, "str",
         "replica warm-starts its estimates; a corrupt file is "
         "quarantined (renamed aside), never fatal")
 
+# plan/optimizer.py (adaptive join execution — stats-driven rewrites)
+declare("CYLON_JOIN_ALGORITHM", "auto", "str",
+        "distributed-join algorithm policy: auto lets the optimizer "
+        "rewrite shuffle joins to broadcast-hash joins from measured "
+        "build-side statistics; shuffle disables every adaptive "
+        "rewrite (the exact pre-adaptive program); broadcast forces "
+        "the broadcast path on every eligible join shape")
+declare("CYLON_BROADCAST_MAX_BYTES", 1 << 22, "int",
+        "broadcast-hash-join budget: a join side whose MEASURED size "
+        "(EWMA x CYLON_STATS_SAFETY) fits under this many bytes may "
+        "be replicated to every shard instead of hash-exchanged "
+        "(requires CYLON_STATS_MIN_OBS successful observations and a "
+        "probe side measured at least BROADCAST_MIN_RATIO x larger); "
+        "0 disables the rewrite", lo=0)
+declare("CYLON_SALT_FACTOR", 4, "int",
+        "hot-key salting spread: a standalone exchange whose measured "
+        "skew crossed CYLON_SKEW_WARN_FACTOR splits each hot "
+        "destination's rows across this many sub-buckets (consecutive "
+        "shards; pow2-floored — the factor keys one compiled program "
+        "per octave), bounding the max shard under Zipfian keys; 0 or "
+        "1 disables salting", lo=0)
+
 
 if __name__ == "__main__":  # pragma: no cover - doc regeneration
     print(render_table())

@@ -58,7 +58,11 @@ QUERY_ROOT_NAMES = ("plan.query",)
 # trees, so /queries can afford deeper history than forensics
 RING_FACTOR = 4
 
-DIGEST_SCHEMA_VERSION = 2   # v2: + est_bytes / est_source (PR 12)
+# v2: + est_bytes / est_source (PR 12)
+# v3: + join_algorithms / salted_exchanges (PR 15 — "which queries
+#      went broadcast, and did they win" is joinable offline from the
+#      JSONL alone against exec_ms / shuffle_bytes)
+DIGEST_SCHEMA_VERSION = 3
 
 
 def _ring_size() -> int:
@@ -80,6 +84,8 @@ def digest(root) -> dict:
     retries = 0
     peak_hbm: Optional[int] = None
     skew_max: Optional[float] = None
+    join_algos = set()
+    salted = 0
     for node in root.walk():
         at = node.attrs
         if node.name.startswith("shuffle.exchange"):
@@ -94,6 +100,11 @@ def digest(root) -> dict:
         si = at.get("skew_imbalance")
         if si is not None:
             skew_max = max(skew_max or 0.0, float(si))
+        ja = at.get("join_algorithm")
+        if ja is not None:
+            join_algos.add(str(ja))
+        if at.get("salted"):
+            salted += 1
     return {
         "v": DIGEST_SCHEMA_VERSION,
         "time_unix": round(time.time(), 3),
@@ -118,6 +129,11 @@ def digest(root) -> dict:
         "shuffles": shuffles,
         "shuffle_bytes": shuffle_bytes,
         "shuffle_rows": shuffle_rows,
+        # the algorithms this query's joins actually RAN (runtime-
+        # honest, from the lowering's span attrs) and how many of its
+        # exchanges took the hot-key salted path
+        "join_algorithms": sorted(join_algos),
+        "salted_exchanges": salted,
         "retries": retries,
         "peak_hbm_bytes": peak_hbm,
         "skew_imbalance_max": skew_max,

@@ -193,6 +193,20 @@ class _Entry:
 # false-fire it); it is stored for the observatory only.
 _DRIFT_METRICS = ("bytes", "rows")
 
+# metrics drift-checked on join_input (decision) entries: the measured
+# per-side input sizes the broadcast-join rewrite consumes — a drifted
+# build side is exactly the mis-learned-broadcast signal that must
+# evict and revert
+_JOIN_INPUT_METRICS = ("left_bytes", "right_bytes")
+
+# metrics whose qualification (count crossing CYLON_STATS_MIN_OBS) can
+# CHANGE an optimizer decision — broadcast build-side sizes and
+# exchange skew. Crossing (or drifting) any of these bumps the stats
+# EPOCH, which is what tells the plan cache a cached template's
+# algorithm choices may be stale (service/plancache.py re-checks the
+# decision vector instead of replaying the template blindly)
+_ADAPTIVE_METRICS = frozenset(_JOIN_INPUT_METRICS) | {"skew"}
+
 
 class StatsStore:
     """The thread-safe two-level store. One process-global instance
@@ -208,6 +222,13 @@ class StatsStore:
         self._nodes: Dict[str, _Entry] = {}
         self._drift: deque = deque(maxlen=DRIFT_RING)
         self._loaded_from: Optional[str] = None
+        # monotonic counter of "an adaptive decision input changed":
+        # qualification crossings and drift resets of _ADAPTIVE_METRICS
+        # entries, plus warm-start loads. The plan cache records the
+        # epoch each template was optimized under; a mismatch makes a
+        # hit re-check its decision vector instead of replaying a
+        # possibly-stale algorithm choice.
+        self._epoch = 0
 
     # -- feeding ------------------------------------------------------
 
@@ -233,17 +254,38 @@ class StatsStore:
         for node in root.walk():
             at = node.attrs
             fp = at.get("stats_fp")
-            if not fp:
-                continue
-            self._observe_node(
-                plan_fp, fp, str(at.get("stats_kind") or "node"),
-                at.get("bytes_out"), at.get("rows_out"),
-                at.get("est_bytes"), now)
+            if fp:
+                self._observe_node(
+                    plan_fp, fp, str(at.get("stats_kind") or "node"),
+                    {"bytes": at.get("bytes_out"),
+                     "rows": at.get("rows_out")},
+                    _DRIFT_METRICS, at.get("est_bytes"), now)
+            dfp = at.get("stats_decision_fp")
+            if dfp and at.get("left_in_bytes") is not None:
+                # the join's measured per-side INPUT sizes, keyed by
+                # the algorithm-invariant decision fingerprint — the
+                # broadcast rewrite's evidence base, fed by shuffle
+                # and broadcast executions alike
+                self._observe_node(
+                    plan_fp, dfp, "join_input",
+                    {"left_bytes": at.get("left_in_bytes"),
+                     "right_bytes": at.get("right_in_bytes")},
+                    _JOIN_INPUT_METRICS, None, now)
+            elif dfp and at.get("skew_max") is not None:
+                # a standalone exchange's pre-mitigation skew, keyed
+                # by the SAME rewrite-invariant normalization (the
+                # salted path records the RAW count matrix, so the
+                # salting decision never oscillates on its own
+                # mitigation, and elision below the shuffle never
+                # forks the evidence away from the decision's key)
+                self._observe_node(
+                    plan_fp, dfp, "exchange",
+                    {"skew": at.get("skew_max")}, (), None, now)
 
     def _observe_node(self, plan_fp: str, node_fp: str, kind: str,
-                      bytes_out, rows_out, est_bytes,
+                      measured: dict, drift_names, est_bytes,
                       now: float) -> None:
-        q = qerror(est_bytes, bytes_out)
+        q = qerror(est_bytes, measured.get("bytes"))
         if q is not None:
             _metrics.REGISTRY.histogram(
                 "cylon_estimate_qerror", {"kind": kind},
@@ -253,23 +295,40 @@ class StatsStore:
             if entry is None:
                 entry = self._nodes[node_fp] = _Entry(kind=kind)
             entry.last_unix = now
-            measured = {"bytes": bytes_out, "rows": rows_out}
             floor = min_obs()
             factor = drift_factor()
             drifted = None
-            for name in _DRIFT_METRICS:
-                v = measured.get(name)
+            for name, v in measured.items():
                 if v is None:
                     continue
                 m = entry.metric(name)
                 ratio = qerror(m.ewma, float(v)) \
-                    if m.count >= floor else None
+                    if name in drift_names and m.count >= floor \
+                    else None
                 if ratio is not None and ratio > factor:
                     drifted = {"metric": name, "ewma": m.ewma,
                                "measured": float(v),
                                "factor": round(ratio, 2)}
                     break
+                warn = _knobs.get("CYLON_SKEW_WARN_FACTOR")
+                was_hot = name == "skew" and m.count >= floor \
+                    and m.ewma is not None and m.ewma >= warn
                 m.observe(float(v))
+                if m.count == floor and name in _ADAPTIVE_METRICS:
+                    # a decision input just QUALIFIED: cached plan
+                    # templates may now choose differently
+                    self._epoch += 1
+                elif name == "skew" and m.count > floor \
+                        and (m.ewma >= warn) != was_hot:
+                    # the qualified skew EWMA crossed the warning
+                    # threshold (either direction): the salting
+                    # decision flips, so cached templates must
+                    # re-decide — skew is deliberately NOT
+                    # drift-checked (a shifting key distribution is a
+                    # salting trigger, not a reason to forget the
+                    # output-size history), so this crossing is its
+                    # epoch signal
+                    self._epoch += 1
             if drifted is not None:
                 # the learned regime is gone: reset EVERY metric of
                 # this entry and seed fresh from the new measurements
@@ -277,10 +336,10 @@ class StatsStore:
                 # back to the static bound until re-learned)
                 for m in entry.metrics.values():
                     m.reset()
-                for name in _DRIFT_METRICS:
-                    v = measured.get(name)
+                for name, v in measured.items():
                     if v is not None:
                         entry.metric(name).observe(float(v))
+                self._epoch += 1
                 event = {"action": "stats_drift", "plan_fp": plan_fp,
                          "node_fp": node_fp, "kind": kind,
                          "time_unix": round(now, 3), **drifted}
@@ -331,6 +390,42 @@ class StatsStore:
             entry = self._nodes.get(node_fp)
             m = entry.metrics.get("bytes") if entry is not None else None
             return m.count if m is not None else 0
+
+    def _qualified_ewma(self, node_fp: str, metric: str
+                        ) -> Optional[float]:
+        """One metric's EWMA, or None until it has >=
+        ``CYLON_STATS_MIN_OBS`` observations (caller holds no lock)."""
+        with self._lock:
+            entry = self._nodes.get(node_fp)
+            m = entry.metrics.get(metric) if entry is not None else None
+            if m is None or m.count < min_obs() or m.ewma is None:
+                return None
+            return m.ewma
+
+    def join_input_bytes(self, decision_fp: Optional[str]
+                         ) -> Tuple[Optional[float], Optional[float]]:
+        """The measured (left, right) input-size EWMAs of one join
+        decision fingerprint — each None until qualified. What the
+        broadcast-join rewrite consumes."""
+        if decision_fp is None:
+            return None, None
+        return (self._qualified_ewma(decision_fp, "left_bytes"),
+                self._qualified_ewma(decision_fp, "right_bytes"))
+
+    def node_skew(self, node_fp: Optional[str]) -> Optional[float]:
+        """The measured exchange-skew EWMA (pre-mitigation imbalance
+        factor) of one node fingerprint, or None until qualified.
+        What the hot-key salting rewrite consumes."""
+        if node_fp is None:
+            return None
+        return self._qualified_ewma(node_fp, "skew")
+
+    def epoch(self) -> int:
+        """Monotonic adaptive-decision epoch: bumps whenever a
+        decision input qualifies, drifts, or warm-starts — the plan
+        cache's staleness signal (see service/plancache.py)."""
+        with self._lock:
+            return self._epoch
 
     # -- observatory --------------------------------------------------
 
@@ -479,6 +574,8 @@ class StatsStore:
             for fp, e in nodes.items():
                 self._nodes.setdefault(fp, e)
             self._loaded_from = path
+            # warm-started evidence can change adaptive choices
+            self._epoch += 1
         n = len(plans) + len(nodes)
         _spans.logger.info("stats: warm-started %d entries from %s",
                            n, path)
@@ -504,12 +601,15 @@ class StatsStore:
             "starting with a fresh store", path, qpath, event["error"])
 
     def reset(self) -> None:
-        """Drop every learned entry and drift event (test isolation)."""
+        """Drop every learned entry and drift event (test isolation).
+        The epoch BUMPS (never rewinds): cached templates optimized
+        against the dropped evidence are stale, not fresh."""
         with self._lock:
             self._plans.clear()
             self._nodes.clear()
             self._drift.clear()
             self._loaded_from = None
+            self._epoch += 1
 
 
 def qerror_quantiles() -> Dict[str, dict]:
@@ -561,6 +661,19 @@ def effective_bytes(node_fp: Optional[str], static_bytes: Optional[int]
 
 def node_obs(node_fp: str) -> int:
     return STORE.node_obs(node_fp)
+
+
+def join_input_bytes(decision_fp: Optional[str]
+                     ) -> Tuple[Optional[float], Optional[float]]:
+    return STORE.join_input_bytes(decision_fp)
+
+
+def node_skew(node_fp: Optional[str]) -> Optional[float]:
+    return STORE.node_skew(node_fp)
+
+
+def epoch() -> int:
+    return STORE.epoch()
 
 
 def recent_drift() -> List[dict]:

@@ -70,7 +70,12 @@ LOWER_IS_BETTER = {"compile.distinct_kernel_signatures",
                    # real once TPU rounds resume (r05 is cpu-fallback)
                    "shuffle_pipeline.exchange_wall_s",
                    "shuffle_pipeline.partition_wall_s",
-                   "shuffle_pipeline.collective_launches"}
+                   "shuffle_pipeline.collective_launches",
+                   # the salted exchange's max/mean shard-row
+                   # imbalance under the Zipfian bench key: 1.0 is a
+                   # perfect spread, a rise means hot-key salting got
+                   # worse at bounding the max shard
+                   "adaptive_join.salted_imbalance"}
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -147,7 +152,9 @@ def flatten_metrics(parsed: Optional[dict]) -> Dict[str, float]:
                             ("wait_p95_s", "wait_p95_s"),
                             ("qerror_p95", "qerror_p95"),
                             ("stats_informed_admits",
-                             "stats_informed_admits")):
+                             "stats_informed_admits"),
+                            ("broadcast_speedup", "broadcast_speedup"),
+                            ("salted_imbalance", "salted_imbalance")):
             v = _num(cfg.get(src))
             if v is not None:
                 out[f"{name}.{suffix}"] = v

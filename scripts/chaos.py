@@ -31,6 +31,16 @@ scripts/check.sh after the telemetry smoke gate):
   (next optimize is a miss), and the next admission decision falls
   back to ``est_source=static`` — while the drifted run's results
   stay bit-identical to an uncached baseline.
+* ``mislearn``   — the adaptive-join drill (PR 15): the stats store is
+  POISONED with a 100x-understated build-side estimate on a learned
+  join fingerprint, so the optimizer rewrites the shape to a
+  broadcast-hash join it should never have chosen. The broadcast run
+  itself measures the TRUE input sizes under the same (algorithm-
+  invariant) decision fingerprint, drift fires
+  (``cylon_stats_drift_total``), the plan-cache entry evicts, and the
+  next optimize REVERTS to the shuffle join — with results
+  bit-identical to an uncached baseline at every step (a mis-learned
+  rewrite may waste memory for one run; it can never corrupt data).
 * ``service``    — the CONCURRENT drill (PR 7): 6 queries across two
   tenants plus one over-budget query submitted through the
   ``QueryService`` while a transient exchange fault is armed and the
@@ -72,7 +82,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 SCENARIOS = ("compile", "transient", "overlap", "persistent", "shed",
-             "degrade", "deadline", "stats", "service")
+             "degrade", "deadline", "stats", "mislearn", "service")
 
 
 class ChaosFailure(AssertionError):
@@ -482,6 +492,71 @@ def run_seed(seed: int, only=None) -> dict:
         _leak_check(ledger, held, "stats", seed, None)
         ran["stats"] = {"quarantine": quarantines[-1]["error"][:60],
                         "drift": drifts[-1]["metric"]}
+
+    # -- mislearn: poisoned stats -> unsound-by-stats broadcast choice
+    # self-corrects via drift eviction, zero wrong results throughout
+    if wants("mislearn"):
+        from cylon_tpu.plan.fingerprint import join_decision_fingerprint
+        from cylon_tpu.plan.optimizer import BROADCAST_MIN_RATIO
+        from cylon_tpu.service import plancache
+        from cylon_tpu.telemetry import stats as stats_mod
+
+        stats_mod.reset()
+        ml, mr = _tables(ct, ctx, n, seed + 300)
+
+        def mpipe():
+            return plan.scan(ml).join(plan.scan(mr), on="k")
+
+        with plancache.disabled():
+            mbase = mpipe().execute()
+        world = ctx.get_world_size()
+        # poison: REPLACE the learned evidence with a build (right)
+        # side measured at ~1/100 of its true size, the probe
+        # comfortably past the ratio guard — the mis-learned state a
+        # corrupted snapshot or a regime change could leave behind
+        # (the baseline's own genuine observation is dropped first:
+        # poisoning means the store's memory IS the lie)
+        stats_mod.reset()
+        real = float(mr.nbytes)
+        assert float(ml.nbytes) >= BROADCAST_MIN_RATIO * real / 100.0
+        fp = join_decision_fingerprint(mpipe()._node, world)
+        for i in range(stats_mod.min_obs()):
+            stats_mod.STORE._observe_node(
+                "poisoned", fp, "join_input",
+                {"left_bytes": float(ml.nbytes),
+                 "right_bytes": max(real / 100.0, 1.0)},
+                ("left_bytes", "right_bytes"), None, float(i))
+        txt = mpipe().explain()
+        _check("algo=broadcast" in txt,
+               f"poisoned stats did not fire the broadcast rewrite:\n"
+               f"{txt}", "mislearn", seed, None)
+        d0 = telemetry.metrics_snapshot().get(
+            "cylon_stats_drift_total", 0)
+        bad_run = mpipe().execute()    # broadcast runs, measures truth
+        _check(_same_result(bad_run, mbase),
+               "mis-learned broadcast run diverges from the uncached "
+               "baseline", "mislearn", seed, None)
+        _check(telemetry.metrics_snapshot().get(
+            "cylon_stats_drift_total", 0) > d0,
+               "true input sizes did not fire drift on the poisoned "
+               "fingerprint", "mislearn", seed, None)
+        drifts = [d for d in flight.admissions()
+                  if d.get("action") == "stats_drift"]
+        _check(bool(drifts), "no stats_drift event in the admission "
+               "ring", "mislearn", seed, None)
+        txt2 = mpipe().explain()
+        _check("algo=broadcast" not in txt2,
+               f"drift did not revert the shape to shuffle:\n{txt2}",
+               "mislearn", seed, None)
+        good_run = mpipe().execute()
+        _check(_same_result(good_run, mbase),
+               "post-revert shuffle run diverges from the uncached "
+               "baseline", "mislearn", seed, None)
+        del bad_run, good_run, mbase, ml, mr
+        stats_mod.reset()
+        _leak_check(ledger, held, "mislearn", seed, None)
+        ran["mislearn"] = {"drift": drifts[-1]["metric"],
+                           "reverted": True}
 
     # -- service: concurrent submissions, fault + shed among them -----
     if wants("service"):

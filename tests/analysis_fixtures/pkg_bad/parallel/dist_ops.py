@@ -43,3 +43,7 @@ def _chunk_rogue_fn(mesh, block, chunk_block):  # SEEDED: collectives/uncataloge
 
 def _partition_rogue_fn(mesh, block, part):  # SEEDED: collectives/uncataloged-factory (partition-path control)
     return mesh
+
+
+def _bcast_rogue_fn(mesh, join_type):  # SEEDED: collectives/uncataloged-factory (broadcast-path control)
+    return mesh

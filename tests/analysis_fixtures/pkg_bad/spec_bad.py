@@ -109,3 +109,20 @@ def run_partitioned(mesh, counts):
     _partition_exchange_fn(mesh, block, "pallas")   # clean: bucketed+path
     raw = int(np.asarray(jax.device_get(counts)).max())
     _partition_exchange_fn(mesh, raw, "sort")  # SEEDED: raw capacity key
+
+
+@counted_cache
+def _salted_exchange_fn(mesh, salt: int):
+    """Salted-exchange-shaped factory: the salt factor keys compiled
+    programs, so it must arrive structural (the declared knob), never
+    a data-dependent count."""
+    def kernel(x):
+        return x
+
+    return jax.jit(kernel)
+
+
+def run_salted(mesh, counts):
+    _salted_exchange_fn(mesh, 4)            # clean: structural literal
+    raw = int(np.asarray(jax.device_get(counts)).max())
+    _salted_exchange_fn(mesh, raw)   # SEEDED: raw capacity as salt key

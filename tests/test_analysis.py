@@ -244,7 +244,7 @@ def test_collectives_uncataloged_factory_fixture():
     got = {(f.path, f.rule) for f in res.findings}
     assert got == {("parallel/dist_ops.py",
                     "collectives/uncataloged-factory")}, res.format_text()
-    assert len(res.findings) == 3
+    assert len(res.findings) == 4
     names = " ".join(f.message for f in res.findings)
     assert "_rogue_kernel_fn" in names
     # the chunked-exchange-shaped factory is swept the same way: a new
@@ -252,6 +252,8 @@ def test_collectives_uncataloged_factory_fixture():
     assert "_chunk_rogue_fn" in names
     # …as is a partition-path-shaped factory (the Pallas-kernel route)
     assert "_partition_rogue_fn" in names
+    # …and a broadcast-join-shaped factory (the adaptive-join route)
+    assert "_bcast_rogue_fn" in names
     # _host_helper_fn opted out on its def line — suppressed, visible
     assert res.suppressed == 1
 
@@ -490,6 +492,10 @@ def test_specialization_fixture_reports_exactly_seeded():
         # the partition-path-shaped factory: bucketed block + literal
         # path string clean, the raw capacity key a finding
         ("spec_bad.py", 111, "specialization/unbucketed-capacity"),
+        # the salted-exchange-shaped factory: the structural salt
+        # literal stays clean, a raw runtime count as the salt key is
+        # a finding
+        ("spec_bad.py", 128, "specialization/unbucketed-capacity"),
     }, res.format_text()
     # the reasoned per-line disable on the env-sourced cap counted
     assert res.suppressed == 1

@@ -102,6 +102,30 @@ def test_shuffle_pipeline_metrics_flatten_and_gate_lower(tmp_path):
     assert "shuffle_pipeline.collective_launches" in regs
 
 
+def test_adaptive_join_metrics_flatten_and_gate(tmp_path):
+    """The adaptive-join metrics flatten — broadcast_speedup judged by
+    drop (higher is better), salted_imbalance LOWER_IS_BETTER (a rise
+    means hot-key salting got worse at bounding the max shard)."""
+    flat = benchtrend.flatten_metrics(_artifact(
+        1e6, suite={"adaptive_join": {"broadcast_speedup": 2.5,
+                                      "salted_imbalance": 1.1}}))
+    assert flat["adaptive_join.broadcast_speedup"] == 2.5
+    assert flat["adaptive_join.salted_imbalance"] == 1.1
+    assert "adaptive_join.salted_imbalance" in \
+        benchtrend.LOWER_IS_BETTER
+    assert "adaptive_join.broadcast_speedup" not in \
+        benchtrend.LOWER_IS_BETTER
+    lose = _write_rounds(tmp_path, {
+        1: _artifact(1e6, suite={"adaptive_join": {
+            "broadcast_speedup": 2.5, "salted_imbalance": 1.1}}),
+        2: _artifact(1e6, suite={"adaptive_join": {
+            "broadcast_speedup": 1.2, "salted_imbalance": 2.4}})})
+    regs = {m for m, *_ in benchtrend.find_regressions(
+        benchtrend.load_rounds(lose))}
+    assert "adaptive_join.broadcast_speedup" in regs
+    assert "adaptive_join.salted_imbalance" in regs
+
+
 def test_signature_count_is_judged_lower_is_better(tmp_path):
     """The recompile-cardinality metric inverts the gate: a round that
     HALVES distinct signatures (the bucketing win) passes, a round

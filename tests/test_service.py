@@ -203,7 +203,7 @@ def test_cache_does_not_pin_tables(dist_ctx):
     with cache._lock:
         entries = list(cache._entries.values())
     assert entries
-    for tmpl, _stats in entries:
+    for tmpl, _stats, _epoch, _vec in entries:
         for node in ir.walk(tmpl):
             if node.kind == "scan":
                 assert node.table is None and node.table_id is None
@@ -245,7 +245,7 @@ def test_poisoned_cache_entry_rejected_on_hit(dist_ctx):
     cache = global_cache()
     with cache._lock:
         assert len(cache._entries) == 1
-        (tmpl, _stats), = cache._entries.values()
+        (tmpl, _stats, _epoch, _vec), = cache._entries.values()
     poisoned = False
     for node in ir.walk(tmpl):
         if node.kind == "groupby" and not node.local_ok:

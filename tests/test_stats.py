@@ -95,7 +95,9 @@ def test_effective_bytes_gating_and_soundness(monkeypatch):
 
     def feed(n_obs, v=1000.0):
         for _ in range(n_obs):
-            s._observe_node("pfp", "nfp", "join", v, 10, None, 0.0)
+            s._observe_node("pfp", "nfp", "join",
+                            {"bytes": v, "rows": 10},
+                            ("bytes", "rows"), None, 0.0)
 
     feed(2)
     # below the observation floor: the static bound rules
@@ -152,11 +154,18 @@ def test_execute_feeds_warehouse_and_qerror(dist_ctx):
     st = stats_mod.state()
     assert st["plan_count"] == 1
     # join + groupby sub-fingerprints observed (folded shuffles never
-    # execute standalone, so they contribute no node entries)
-    assert st["node_count"] == 2
+    # execute standalone, so they contribute no node entries), plus
+    # the join's algorithm-invariant DECISION entry carrying both
+    # sides' measured input sizes (the broadcast rewrite's evidence)
+    assert st["node_count"] == 3
     kinds = {e["kind"] for e in st["nodes"]}
-    assert kinds == {"join", "groupby"}
+    assert kinds == {"join", "groupby", "join_input"}
     for e in st["nodes"]:
+        if e["kind"] == "join_input":
+            assert e["metrics"]["left_bytes"]["count"] == 1
+            assert e["metrics"]["left_bytes"]["ewma"] > 0
+            assert e["metrics"]["right_bytes"]["count"] == 1
+            continue
         assert e["metrics"]["bytes"]["count"] == 1
         assert e["metrics"]["bytes"]["ewma"] > 0
         assert e["metrics"]["rows"]["count"] == 1
@@ -422,8 +431,9 @@ def test_drift_fires_evicts_and_reverts_to_static(
 
 def _seed_store(s, n_obs=3):
     for i in range(n_obs):
-        s._observe_node("pfp", "nfp", "join", 1000.0 + i, 10 + i,
-                        2000.0, float(i))
+        s._observe_node("pfp", "nfp", "join",
+                        {"bytes": 1000.0 + i, "rows": 10 + i},
+                        ("bytes", "rows"), 2000.0, float(i))
     return s
 
 
@@ -500,7 +510,9 @@ def test_load_never_clobbers_live_entries(tmp_path):
     path = str(tmp_path / "stats.jsonl")
     s.save(path)
     live = StatsStore()
-    live._observe_node("pfp", "nfp", "join", 7777.0, 1, None, 0.0)
+    live._observe_node("pfp", "nfp", "join",
+                       {"bytes": 7777.0, "rows": 1},
+                       ("bytes", "rows"), None, 0.0)
     live.load(path)
     # the in-process measurement wins; the snapshot fills gaps only
     e = next(e for e in live.state()["nodes"] if e["fp"] == "nfp")
